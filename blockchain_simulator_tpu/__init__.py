@@ -1,0 +1,28 @@
+"""blockchain_simulator_tpu — a TPU-native blockchain-consensus simulation framework.
+
+A from-scratch re-design of the capabilities of `vvvictorlee/blockchain-simulator`
+(an ns-3 C++ discrete-event simulator of PBFT / Raft / Paxos over a full-mesh IP
+network, see /root/reference) as a *tensorized, slotted-time* discrete-event
+simulator built on JAX/XLA for TPUs.
+
+Design shift vs. the reference (reference: blockchain-simulator.cc:57
+``Simulator::Run`` serial event dispatch): the unit of execution here is one
+simulation *tick for all N nodes at once*.  All node state is a struct-of-arrays
+pytree ``[N, ...]``; message passing is a ring buffer of future inboxes indexed
+by ``(tick + delay) % D``; each protocol is a pure
+``step(state, inbox, key, cfg) -> (state', outbox)`` expressed directly as
+vector ops over the node axis, run under ``jax.lax.scan`` + ``jit``.
+
+Subpackages
+-----------
+- ``utils``    — typed config, threaded PRNG, metrics.
+- ``ops``      — delay models, ring-buffer transport, dense/statistical delivery.
+- ``models``   — the three consensus protocol state machines (pbft, raft, paxos).
+- ``parallel`` — mesh / shard_map scale-out, sweep vmapping.
+- ``engine``   — self-contained C++ CPU reference DES for differential testing.
+"""
+
+from blockchain_simulator_tpu.utils.config import SimConfig  # noqa: F401
+from blockchain_simulator_tpu.runner import run_simulation  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from blockchain_simulator_tpu.models.base import get_protocol  # noqa: F401

@@ -1,0 +1,50 @@
+"""Protocol backend API.
+
+The reference's plugin boundary is the per-protocol ``ns3::Application``
+subclass, selected at *compile time* by editing network-helper.cc:17 and
+blockchain-simulator.cc:72 (SURVEY.md §1).  Here a protocol backend is a
+module-level triple of pure functions, selected at *runtime* by name:
+
+- ``init(cfg, key) -> (state, bufs)``       — build the [N, ...] state pytree
+  and the future-inbox ring buffers.
+- ``step(cfg, state, bufs, t, tkey) -> (state, bufs)`` — one 1 ms tick for all
+  N nodes at once (the tensorized equivalent of every event ns-3 would have
+  dispatched in that interval: HandleRead FSM transitions + timer firings).
+- ``metrics(cfg, state) -> dict``           — host-side structured metrics,
+  reproducing the reference's NS_LOG measurement surface (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def get_protocol(name: str):
+    """Runtime protocol selection (fixes the reference's compile-time switch)."""
+    try:
+        if name == "pbft":
+            from blockchain_simulator_tpu.models import pbft as m
+        elif name == "raft":
+            from blockchain_simulator_tpu.models import raft as m
+        elif name == "paxos":
+            from blockchain_simulator_tpu.models import paxos as m
+        else:
+            raise ValueError(f"unknown protocol {name!r}")
+    except ImportError as e:
+        raise NotImplementedError(f"protocol backend {name!r} not available: {e}") from e
+    return m
+
+
+def fault_masks(cfg, n: int):
+    """(alive[N], honest[N]) bool masks from the fault config.
+
+    Crashed nodes occupy the last ``n_crashed`` ids, Byzantine the last
+    ``n_byzantine`` alive ids before them — so node 0 (PBFT initial leader,
+    Paxos proposer) stays honest/alive under small fault counts."""
+    f = cfg.faults
+    nc = f.resolved_n_crashed(n)
+    ids = np.arange(n)
+    alive = ids < (n - nc)
+    honest = ids < (n - nc - f.n_byzantine)
+    return jnp.asarray(alive), jnp.asarray(honest)

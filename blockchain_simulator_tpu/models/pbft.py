@@ -1,0 +1,322 @@
+"""PBFT consensus — tensorized state machine.
+
+Re-design of the reference's ``PbftNode`` (pbft/pbft-node.h:19, pbft-node.cc):
+a leader-driven 3-phase commit where the leader broadcasts PRE_PREPARE blocks
+every 50 ms (SendBlock, pbft-node.cc:372-411), replicas broadcast PREPARE on
+receipt (pbft-node.cc:193-211), every PREPARE is answered with a unicast
+PREPARE_RES SUCCESS (pbft-node.cc:212-221), a node crossing
+``prepare_vote >= N/2`` broadcasts COMMIT (pbft-node.cc:223-239), and a node
+crossing ``commit_vote > N/2`` commits the block (pbft-node.cc:241-264 — the
+finality measurement point, line 259).  A leader round has a 1/100 chance of a
+view change rotating the leader (pbft-node.cc:294-303,401-403).
+
+Tensorization (SURVEY.md §7): one tick = 1 ms for all N nodes at once.
+
+- The per-``(v,n)`` vote table ``TX tx[1000]`` (pbft-node.h:50-56) becomes
+  ``[N, S]`` counter arrays.
+- PREPARE handling is *short-circuited*: a peer's reply never depends on its
+  state, so a PREPARE broadcast by node i at tick t directly schedules N-1
+  PREPARE_RES arrivals at i over the request+reply delay distribution.
+- COMMIT / PRE_PREPARE are slot-keyed aggregate broadcasts.
+- The reference's process-global ``v, n, val, n_round`` (pbft-node.cc:24-30,
+  quirk #10 in SURVEY.md §2) become per-node state; a new leader infers the
+  next sequence number from the highest PRE_PREPARE slot it has seen.
+- Echo-back (quirk #1) is not modeled in the JAX backend (the C++ reference
+  engine models it exactly; differential tests run with echo off).
+
+Fidelity modes: ``reference`` keeps N/2 thresholds and reset-on-threshold
+counters (quirks #2, #4 — duplicate commits possible); ``clean`` latches each
+(node, slot) so a slot commits exactly once.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from blockchain_simulator_tpu.models.base import fault_masks
+from blockchain_simulator_tpu.ops import delay as delay_ops
+from blockchain_simulator_tpu.ops import delivery as dv
+from blockchain_simulator_tpu.ops.ring import ring_pop, ring_push_add, ring_push_max
+from blockchain_simulator_tpu.utils.prng import Channel, chan_key
+
+
+@struct.dataclass
+class PbftState:
+    v: jax.Array            # [N] current view (init 1, pbft-node.cc:101)
+    leader: jax.Array       # [N] believed leader (init 0)
+    next_n: jax.Array       # [N] next sequence number a leader would use
+    rounds_sent: jax.Array  # [N] blocks broadcast as leader (global n_round analog)
+    tx_val: jax.Array       # [N, S] stored block value per slot (tx[n].val)
+    prepare_vote: jax.Array  # [N, S]
+    commit_vote: jax.Array   # [N, S]
+    prep_sent: jax.Array     # [N, S] bool — COMMIT already broadcast (clean latch)
+    committed: jax.Array     # [N, S] bool — slot finalized
+    commit_tick: jax.Array   # [N, S] first commit tick, -1 = never
+    block_num: jax.Array     # [N] commits counted (duplicates possible in
+    # reference fidelity, matching pbft-node.cc:260)
+    view_changes: jax.Array  # [N] view changes initiated
+    alive: jax.Array         # [N] bool fault mask
+    honest: jax.Array        # [N] bool fault mask
+
+
+@struct.dataclass
+class PbftBufs:
+    pp: jax.Array       # [D, N, S] PRE_PREPARE arrival counts
+    prep_rt: jax.Array  # [D, N, S] PREPARE_RES (round-trip) reply counts
+    commit: jax.Array   # [D, N, S] COMMIT arrival counts
+    vc: jax.Array       # [D, N] VIEW_CHANGE, encoded v*N + leader + 1, max
+
+
+def init(cfg, key=None):
+    n, s = cfg.n, cfg.pbft_max_slots
+    d = cfg.ring_depth
+    alive, honest = fault_masks(cfg, n)
+    zi = lambda *sh: jnp.zeros(sh, jnp.int32)
+    zb = lambda *sh: jnp.zeros(sh, bool)
+    state = PbftState(
+        v=jnp.ones((n,), jnp.int32),
+        leader=zi(n),
+        next_n=zi(n),
+        rounds_sent=zi(n),
+        tx_val=jnp.full((n, s), -1, jnp.int32),
+        prepare_vote=zi(n, s),
+        commit_vote=zi(n, s),
+        prep_sent=zb(n, s),
+        committed=zb(n, s),
+        commit_tick=jnp.full((n, s), -1, jnp.int32),
+        block_num=zi(n),
+        view_changes=zi(n),
+        alive=alive,
+        honest=honest,
+    )
+    bufs = PbftBufs(pp=zi(d, n, s), prep_rt=zi(d, n, s), commit=zi(d, n, s), vc=zi(d, n))
+    return state, bufs
+
+
+def _gated(pred, fn, zeros):
+    """Skip a delivery computation when no sender is active this tick."""
+    return jax.lax.cond(pred, fn, lambda: zeros)
+
+
+def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
+    n, s = cfg.n, cfg.pbft_max_slots
+    lo, hi = cfg.one_way_range()
+    rt_lo, rt_hi = cfg.roundtrip_range()
+    drop = cfg.faults.drop_prob
+    clean = cfg.fidelity == "clean"
+    stat = cfg.delivery == "stat"
+    ow_probs = delay_ops.uniform_probs(lo, hi)
+    rt_probs = delay_ops.roundtrip_probs(lo, hi)
+    ids = jnp.arange(n)
+    slots = jnp.arange(s)
+
+    # ---- pop this tick's arrivals; crashed nodes process nothing ------------
+    pp_t, pp = ring_pop(bufs.pp, t)
+    prep_t, prep_rt = ring_pop(bufs.prep_rt, t)
+    com_t, commit = ring_pop(bufs.commit, t)
+    vc_t, vc = ring_pop(bufs.vc, t)
+    am = state.alive.astype(jnp.int32)
+    pp_t, prep_t, com_t = pp_t * am[:, None], prep_t * am[:, None], com_t * am[:, None]
+    vc_t = vc_t * am
+
+    # ---- VIEW_CHANGE arrivals: adopt (v, leader) (pbft-node.cc:271-280) -----
+    has_vc = vc_t > 0
+    v = jnp.where(has_vc, (vc_t - 1) // n, state.v)
+    leader = jnp.where(has_vc, (vc_t - 1) % n, state.leader)
+
+    # ---- PRE_PREPARE arrivals: store value, then broadcast PREPARE ----------
+    got_pp = pp_t > 0  # [N, S]
+    # the reference block header carries val == n (generateTX, pbft-node.cc:92)
+    tx_val = jnp.where(got_pp, slots[None, :], state.tx_val)
+    seen_hi = jnp.max(jnp.where(got_pp, slots[None, :] + 1, 0), axis=1)
+    next_n = jnp.maximum(state.next_n, seen_hi)
+
+    # PREPARE broadcast → short-circuited round-trip PREPARE_RES replies.
+    # Only honest, alive peers contribute SUCCESS votes (Byzantine nodes flip
+    # their votes to FAILED, which the counter ignores, pbft-node.cc:227).
+    voters = state.alive & state.honest
+    k_rt = chan_key(tkey, Channel.DELAY_ROUNDTRIP)
+    prep_active = got_pp.any(axis=1)
+    if stat:
+        n_voters = voters.astype(jnp.int32).sum()
+        rt_counts = _gated(
+            prep_active.any(),
+            lambda: dv.roundtrip_reply_counts_stat(
+                k_rt, prep_active, n_voters - voters.astype(jnp.int32), rt_probs, drop
+            ),
+            jnp.zeros((len(rt_probs), n), jnp.int32),
+        )
+    else:
+        rt_counts = _gated(
+            prep_active.any(),
+            lambda: dv.roundtrip_reply_counts_dense(
+                k_rt, prep_active, lo, hi, drop, peer_mask=voters
+            ),
+            jnp.zeros((len(rt_probs), n), jnp.int32),
+        )
+    # replies are per broadcast, i.e. per active (node, slot)
+    prep_rt = ring_push_add(
+        prep_rt, t, rt_lo, rt_counts[:, :, None] * got_pp.astype(jnp.int32)[None, :, :]
+    )
+
+    # ---- PREPARE_RES arrivals → prepare_vote → COMMIT broadcast -------------
+    pv = state.prepare_vote + prep_t
+    crossed_p = (prep_t > 0) & (pv >= cfg.quorum)  # pbft-node.cc:231
+    if clean:
+        crossed_p = crossed_p & ~state.prep_sent
+    prep_sent = state.prep_sent | crossed_p
+    prepare_vote = jnp.where(crossed_p, 0, pv)  # reset on threshold (quirk #4)
+
+    commit_send = crossed_p & (state.alive & state.honest)[:, None]
+    k_cm = chan_key(tkey, Channel.DELAY_BCAST)
+    zeros_slots = jnp.zeros((hi - lo, n, s), jnp.int32)
+    if stat:
+        cm_contrib = _gated(
+            commit_send.any(),
+            lambda: dv.bcast_slots_stat(k_cm, commit_send, ow_probs, drop),
+            zeros_slots,
+        )
+    else:
+        cm_contrib = _gated(
+            commit_send.any(),
+            lambda: dv.bcast_slots_dense(k_cm, commit_send, lo, hi, drop),
+            zeros_slots,
+        )
+    commit = ring_push_add(commit, t, lo, cm_contrib)
+
+    # ---- COMMIT arrivals → commit_vote → finality ---------------------------
+    cv = state.commit_vote + com_t
+    crossed_c = (com_t > 0) & (cv > cfg.quorum)  # pbft-node.cc:248
+    if clean:
+        crossed_c = crossed_c & ~state.committed
+    commit_vote = jnp.where(crossed_c, 0, cv)
+    commit_tick = jnp.where(
+        crossed_c & (state.commit_tick < 0), jnp.int32(t), state.commit_tick
+    )
+    committed = state.committed | crossed_c
+    block_num = state.block_num + crossed_c.sum(axis=1)
+
+    # ---- timers: leader block broadcast every 50 ms (SendBlock) -------------
+    bt = cfg.pbft_block_interval_ms
+    is_block_tick = (t % bt == 0) & (t > 0)
+    # stop at 40 rounds (pbft-node.cc:407). The reference's n_round is
+    # process-global (quirk #10); the per-node analog of global round progress
+    # is the sequence number next_n, so a post-view-change leader continues
+    # the count instead of restarting it.
+    send_block = (
+        is_block_tick
+        & (leader == ids)
+        & (next_n < min(cfg.pbft_max_rounds, s))
+        & state.alive
+    )
+    pp_slot_mat = jax.nn.one_hot(next_n, s, dtype=jnp.int32) * send_block[:, None]
+    ser = cfg.serialization_ticks(cfg.pbft_block_bytes)
+    k_pp = chan_key(tkey, Channel.DELAY_BCAST2)
+    if stat:
+        pp_contrib = _gated(
+            send_block.any(),
+            lambda: dv.bcast_slots_stat(k_pp, pp_slot_mat, ow_probs, drop),
+            zeros_slots,
+        )
+    else:
+        pp_contrib = _gated(
+            send_block.any(),
+            lambda: dv.bcast_slots_dense(k_pp, pp_slot_mat, lo, hi, drop),
+            zeros_slots,
+        )
+    pp = ring_push_add(pp, t, lo + ser, pp_contrib)
+    rounds_sent = state.rounds_sent + send_block
+    next_n = next_n + send_block
+
+    # ---- random view change (P = 1/100 per leader round) --------------------
+    u = jax.random.randint(
+        chan_key(tkey, Channel.VIEW_CHANGE), (n,), 0, cfg.pbft_view_change_den
+    )
+    trigger = send_block & (u < cfg.pbft_view_change_num)
+    new_leader = (leader + 1) % n  # rotation (pbft-node.cc:297)
+    new_v = v + 1
+    leader = jnp.where(trigger, new_leader, leader)
+    v = jnp.where(trigger, new_v, v)
+    view_changes = state.view_changes + trigger
+    enc = jnp.where(trigger, new_v * n + new_leader + 1, 0)
+    k_vc = chan_key(tkey, Channel.DELAY_REPLY)
+    zeros_flat = jnp.zeros((hi - lo, n), jnp.int32)
+    if stat:
+        vc_contrib = _gated(
+            trigger.any(),
+            lambda: dv.bcast_value_max_stat(k_vc, enc, ow_probs, drop),
+            zeros_flat,
+        )
+    else:
+        vc_contrib = _gated(
+            trigger.any(),
+            lambda: dv.bcast_value_max_dense(k_vc, trigger, enc, lo, hi, drop),
+            zeros_flat,
+        )
+    vc = ring_push_max(vc, t, lo, vc_contrib)
+
+    state = state.replace(
+        v=v,
+        leader=leader,
+        next_n=next_n,
+        rounds_sent=rounds_sent,
+        tx_val=tx_val,
+        prepare_vote=prepare_vote,
+        commit_vote=commit_vote,
+        prep_sent=prep_sent,
+        committed=committed,
+        commit_tick=commit_tick,
+        block_num=block_num,
+        view_changes=view_changes,
+    )
+    bufs = PbftBufs(pp=pp, prep_rt=prep_rt, commit=commit, vc=vc)
+    return state, bufs
+
+
+def metrics(cfg, state: PbftState) -> dict:
+    """Reproduce the reference's measurement surface (SURVEY.md §5): per-block
+    commit events with times (pbft-node.cc:259), rounds sent (:408), view
+    changes (:278) — as structured host-side values."""
+    committed = np.asarray(state.committed)
+    ticks = np.asarray(state.commit_tick)
+    alive = np.asarray(state.alive)
+    done = committed[alive]
+    if done.shape[0] == 0:  # fully-crashed cluster: nothing can finalize
+        per_slot_done = np.zeros(done.shape[1], bool)
+    else:
+        per_slot_done = done.all(axis=0)
+    n_final = int(per_slot_done.sum())
+    last = ticks[alive].max() if n_final else -1
+    # time-to-finality per block: commit tick − the tick the block was proposed
+    rounds = int(np.asarray(state.next_n).max())
+    ttf = []
+    for slot in range(rounds):
+        if per_slot_done[slot]:
+            ttf.append(float(ticks[alive, slot].max()) - (slot + 1) * cfg.pbft_block_interval_ms)
+    return {
+        "protocol": "pbft",
+        "n": cfg.n,
+        "rounds_sent": rounds,
+        "leader_rounds_max": int(np.asarray(state.rounds_sent).max()),
+        "blocks_final_all_nodes": n_final,
+        "block_num_max": int(np.asarray(state.block_num).max()),
+        "view_changes": int(np.asarray(state.view_changes).sum()),
+        "last_commit_ms": float(last),
+        "mean_time_to_finality_ms": float(np.mean(ttf)) if ttf else -1.0,
+        # safety: one value per slot across nodes that stored one (the leader
+        # never hears its own PRE_PREPARE, so its slot value stays unset — the
+        # reference leader likewise commits an uninitialized tx[n].val)
+        "agreement_ok": bool(
+            all(
+                len(np.unique(vals[vals >= 0])) <= 1
+                for slot in range(rounds)
+                if per_slot_done[slot]
+                for vals in [np.asarray(state.tx_val)[alive, slot]]
+            )
+        ),
+    }

@@ -1,0 +1,1 @@
+from blockchain_simulator_tpu.ops import delay, delivery, ring  # noqa: F401

@@ -1,0 +1,60 @@
+"""Delay models.
+
+The reference defers every send by ``Simulator::Schedule(getRandomDelay(), ...)``
+with per-protocol uniform distributions (pbft-node.cc:66-69 U{3..5} ms,
+raft-node.cc:63-66 U{0..2} ms, paxos-node.cc:397-400 U[0,50) ms) on top of the
+3 ms point-to-point channel delay (blockchain-simulator.cc:24).  Here a delay is
+an integer number of ticks; two families of samplers:
+
+- *edge* samplers draw one delay per (sender, receiver) edge — exact.
+- *stat* samplers draw per-receiver bucket **counts** directly from the induced
+  binomial/multinomial distribution — statistically exact for full-mesh
+  channels whose receivers only consume counts, and O(N·B) instead of O(N²).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def uniform_probs(lo: int, hi: int) -> np.ndarray:
+    """Bucket probabilities of U{lo..hi-1}, indexed 0..hi-lo-1 (offset lo)."""
+    b = hi - lo
+    return np.full((b,), 1.0 / b)
+
+
+def roundtrip_probs(lo: int, hi: int) -> np.ndarray:
+    """Distribution of the sum of two independent U{lo..hi-1} draws
+    (request delay + reply delay), indexed 0..2*(hi-lo)-2 (offset 2*lo)."""
+    p = uniform_probs(lo, hi)
+    return np.convolve(p, p)
+
+
+def sample_edge_delays(key: jax.Array, shape, lo: int, hi: int) -> jax.Array:
+    """One delay per edge, in [lo, hi)."""
+    return jax.random.randint(key, shape, lo, hi, dtype=jnp.int32)
+
+
+def sample_bucket_counts(key: jax.Array, n: jax.Array, probs: np.ndarray) -> jax.Array:
+    """Split ``n`` (int array, any shape) into bucket counts ~ Multinomial(n, probs).
+
+    Implemented as a chain of binomials over the (small, static) bucket axis.
+    Returns int32 of shape ``(len(probs),) + n.shape``.
+    """
+    n = jnp.asarray(n, jnp.float32)
+    counts = []
+    remaining = n
+    p_left = 1.0
+    for b, pb in enumerate(probs):
+        kb = jax.random.fold_in(key, b)
+        frac = float(min(max(pb / max(p_left, 1e-9), 0.0), 1.0))
+        if b == len(probs) - 1 or frac >= 1.0:
+            c = remaining
+        else:
+            c = jax.random.binomial(kb, remaining, frac)
+        counts.append(c)
+        remaining = remaining - c
+        p_left -= pb
+    return jnp.stack(counts).astype(jnp.int32)

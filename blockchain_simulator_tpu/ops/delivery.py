@@ -1,0 +1,188 @@
+"""Message delivery: senders → per-bucket ring contributions.
+
+Each function turns "who is sending what this tick" into a contribution tensor
+``[B, ...receiver dims]`` to be ``ring_push``-ed, where ``B`` spans the delay
+distribution's support (offset ``lo``).  The reference's per-message
+``Simulator::Schedule(getRandomDelay(), ...)`` (SURVEY.md C8) becomes either an
+exact per-edge sample (*dense*) or a statistically exact per-receiver bucket
+count (*stat*, for full-mesh count-consumed channels at large N).
+
+Conventions: senders never deliver to themselves (the reference's peer lists
+exclude self, network-helper.cc / blockchain-simulator.cc:44-45); ``send`` masks
+are already fault-masked by the caller; ``drop_prob`` models lossy edges (a
+capability absent in the reference — its simulated links never drop).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from blockchain_simulator_tpu.ops.delay import sample_bucket_counts, sample_edge_delays
+
+
+def _edge_hits(key, send, lo: int, hi: int, drop_prob: float = 0.0):
+    """[B, N_send, N_recv] 0/1 delivery indicators, self-edges removed."""
+    n = send.shape[0]
+    d = sample_edge_delays(key, (n, n), lo, hi)
+    mask = send.astype(jnp.int32)[:, None] * (1 - jnp.eye(n, dtype=jnp.int32))
+    if drop_prob > 0.0:
+        keep = jax.random.bernoulli(
+            jax.random.fold_in(key, 0x0D0D), 1.0 - drop_prob, (n, n)
+        )
+        mask = mask * keep.astype(jnp.int32)
+    return jnp.stack([(d == lo + b).astype(jnp.int32) * mask for b in range(hi - lo)])
+
+
+# --------------------------------------------------------------------------- #
+# dense (exact per-edge) delivery                                             #
+# --------------------------------------------------------------------------- #
+
+
+def bcast_counts_dense(key, send, lo, hi, drop_prob=0.0):
+    """Broadcast → per-receiver arrival counts.  Returns [B, N]."""
+    return _edge_hits(key, send, lo, hi, drop_prob).sum(1)
+
+
+def bcast_value_max_dense(key, send, value, lo, hi, drop_prob=0.0):
+    """Broadcast of a per-sender value (>0; 0 = empty), max-combined at the
+    receiver.  Returns [B, N]."""
+    hits = _edge_hits(key, send, lo, hi, drop_prob)
+    return (hits * value.astype(jnp.int32)[None, :, None]).max(1)
+
+
+def bcast_slots_dense(key, slot_mat, lo, hi, drop_prob=0.0):
+    """Slot-keyed broadcast (e.g. PBFT messages carrying seq no n): sender i
+    broadcasts one message per active slot in ``slot_mat[i, s]`` (0/1).
+    Returns arrival counts per (receiver, slot): [B, N, S].
+
+    Note: when a sender is active in several slots in the same tick, those
+    broadcasts share one delay draw per edge (a documented simplification; the
+    reference draws per message, pbft-node.cc:364)."""
+    send = slot_mat.max(axis=1)
+    hits = _edge_hits(key, send, lo, hi, drop_prob)  # [B, N, N]
+    return jnp.einsum("bij,is->bjs", hits, slot_mat.astype(jnp.int32))
+
+
+def roundtrip_reply_counts_dense(key, send, lo, hi, drop_prob=0.0, peer_mask=None):
+    """Short-circuited request/reply round trip: sender i broadcasts, every
+    peer replies unconditionally and instantly, the reply travels back with an
+    independent delay.  Used where the peer's state does not affect the reply
+    (PBFT PREPARE → PREPARE_RES SUCCESS, pbft-node.cc:212-221; Raft HEARTBEAT →
+    HEARTBEAT_RES SUCCESS, raft-node.cc:170-193).  ``peer_mask`` restricts which
+    peers reply (crashed/Byzantine exclusion).  Returns reply counts at the
+    original sender: [B2, N], offset 2*lo, B2 = 2*(hi-lo)-1."""
+    n = send.shape[0]
+    d1 = sample_edge_delays(jax.random.fold_in(key, 1), (n, n), lo, hi)
+    d2 = sample_edge_delays(jax.random.fold_in(key, 2), (n, n), lo, hi)
+    total = d1 + d2  # delay until the reply reaches the sender
+    mask = send.astype(jnp.int32)[:, None] * (1 - jnp.eye(n, dtype=jnp.int32))
+    if peer_mask is not None:
+        mask = mask * peer_mask.astype(jnp.int32)[None, :]
+    if drop_prob > 0.0:
+        # either leg can drop
+        keep = jax.random.bernoulli(
+            jax.random.fold_in(key, 0x0D0E), (1.0 - drop_prob) ** 2, (n, n)
+        )
+        mask = mask * keep.astype(jnp.int32)
+    lo2 = 2 * lo
+    nb = 2 * (hi - lo) - 1
+    return jnp.stack(
+        [((total == lo2 + b).astype(jnp.int32) * mask).sum(1) for b in range(nb)]
+    )
+
+
+def unicast_reply_counts_dense(key, reply, lo, hi, drop_prob=0.0):
+    """Route per-(replier, requester) reply counts back to each requester.
+    ``reply[r, c]`` = number of (identical, count-consumed) replies node r
+    sends node c this tick.  Returns [B, N] indexed by requester c."""
+    n = reply.shape[0]
+    d = sample_edge_delays(key, (n, n), lo, hi)
+    mask = 1 - jnp.eye(n, dtype=jnp.int32)
+    if drop_prob > 0.0:
+        keep = jax.random.bernoulli(
+            jax.random.fold_in(key, 0x0D0F), 1.0 - drop_prob, (n, n)
+        )
+        mask = mask * keep.astype(jnp.int32)
+    r = reply.astype(jnp.int32) * mask
+    return jnp.stack([(r * (d == lo + b)).sum(0) for b in range(hi - lo)])
+
+
+def bcast_matrix_dense(key, send, value, lo, hi, drop_prob=0.0):
+    """Identity-preserving broadcast for request channels whose handling
+    depends on receiver state at arrival (Raft VOTE_REQ, Paxos REQUEST_*).
+    ``value`` (>0 per sender; 0 = empty) lands at ``[b, receiver, sender]``.
+    Returns [B, N, N] (max-combined into a matrix ring)."""
+    hits = _edge_hits(key, send, lo, hi, drop_prob)  # [B, send, recv]
+    return jnp.swapaxes(hits * value.astype(jnp.int32)[None, :, None], 1, 2)
+
+
+# --------------------------------------------------------------------------- #
+# stat (aggregated, statistically exact) delivery                             #
+# --------------------------------------------------------------------------- #
+
+
+def bcast_counts_stat(key, n_senders, is_sender, probs: np.ndarray, drop_prob=0.0):
+    """Full-mesh broadcast arrival counts without materializing edges.
+
+    Each receiver j hears from ``n_senders - is_sender[j]`` peers; its arrival
+    buckets are Multinomial over the delay distribution, independent across
+    receivers (distinct edges ⇒ independent delays).  Returns [B, N]."""
+    m = jnp.asarray(n_senders, jnp.int32) - is_sender.astype(jnp.int32)
+    if drop_prob > 0.0:
+        m = jnp.round(
+            jax.random.binomial(
+                jax.random.fold_in(key, 0x0D10), m.astype(jnp.float32), 1.0 - drop_prob
+            )
+        ).astype(jnp.int32)
+    return sample_bucket_counts(key, m, probs)
+
+
+def bcast_slots_stat(key, slot_mat, probs: np.ndarray, drop_prob=0.0):
+    """Stat version of bcast_slots_dense: receiver j hears, per slot s,
+    from ``(Σ_i slot_mat[i,s]) - slot_mat[j,s]`` senders; arrival buckets are
+    multinomial per (receiver, slot).  Returns [B, N, S]."""
+    sm = slot_mat.astype(jnp.int32)
+    m = sm.sum(axis=0)[None, :] - sm  # [N, S]
+    if drop_prob > 0.0:
+        m = jnp.round(
+            jax.random.binomial(
+                jax.random.fold_in(key, 0x0D12), m.astype(jnp.float32), 1.0 - drop_prob
+            )
+        ).astype(jnp.int32)
+    return sample_bucket_counts(key, m, probs)
+
+
+def bcast_value_max_stat(key, value, probs: np.ndarray, drop_prob=0.0):
+    """Stat version of bcast_value_max_dense for ≤-a-few senders (e.g. PBFT
+    VIEW_CHANGE from the leader): deliver the max announced value to every
+    receiver with one per-receiver delay draw.  Returns [B, N]."""
+    n = value.shape[0]
+    vmax = value.astype(jnp.int32).max()
+    nb = len(probs)
+    d = jax.random.categorical(key, jnp.log(jnp.asarray(probs) + 1e-30), shape=(n,))
+    sent = (vmax > 0).astype(jnp.int32)
+    if drop_prob > 0.0:
+        keep = jax.random.bernoulli(
+            jax.random.fold_in(key, 0x0D13), 1.0 - drop_prob, (n,)
+        )
+        sent = sent * keep.astype(jnp.int32)
+    # a node that announced the (same, max) value already applied it locally;
+    # re-delivery to it is a harmless no-op, matching max-combine semantics
+    return jnp.stack([(d == b).astype(jnp.int32) * sent * vmax for b in range(nb)])
+
+
+def roundtrip_reply_counts_stat(key, send, n_peers, rt_probs: np.ndarray, drop_prob=0.0):
+    """Stat version of roundtrip_reply_counts_dense: each active sender gets
+    ``n_peers`` replies multinomially spread over the round-trip distribution.
+    Returns [B2, N]."""
+    m = send.astype(jnp.int32) * jnp.asarray(n_peers, jnp.int32)
+    if drop_prob > 0.0:
+        p_keep = (1.0 - drop_prob) ** 2
+        m = jnp.round(
+            jax.random.binomial(
+                jax.random.fold_in(key, 0x0D11), m.astype(jnp.float32), p_keep
+            )
+        ).astype(jnp.int32)
+    return sample_bucket_counts(key, m, rt_probs)

@@ -1,0 +1,42 @@
+"""Future-inbox ring buffers.
+
+The reference's entire concurrency model is the ns-3 event queue: every send is
+``Simulator::Schedule(delay, SendPacket, ...)`` (pbft-node.cc:345,365; SURVEY.md
+§3.5).  The tensorized equivalent is a ring buffer over future ticks: a channel
+buffer has shape ``[D, N, ...]``; a message scheduled at tick ``t`` with delay
+``d`` lands in slice ``(t + d) % D``; at tick ``t`` the simulator *pops* slice
+``t % D`` (read + zero).  ``D`` need only exceed the maximum scheduling horizon
+(config.ring_depth), so memory is O(D·N·channel-width) — never O(events).
+
+Channels come in two flavors (SURVEY.md §7 "variable-size inboxes"):
+- **aggregate** channels combine concurrent deliveries with a commutative op
+  (add for vote counts, max for value announcements) — exploiting that the
+  protocols consume most messages as counts;
+- **matrix** channels keep sender identity ``[D, N_recv, N_send]`` for the few
+  request types whose replies must be routed back to the requester.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def ring_pop(buf, t):
+    """Read and clear the current tick's slice. Returns (slice, buf')."""
+    idx = jnp.mod(t, buf.shape[0])
+    cur = buf[idx]
+    return cur, buf.at[idx].set(0)
+
+
+def _idx(buf, t, lo, nb):
+    return jnp.mod(t + lo + jnp.arange(nb), buf.shape[0])
+
+
+def ring_push_add(buf, t, lo: int, contrib):
+    """Scatter-add ``contrib[b, ...]`` into slices ``t+lo+b``, b in [0, B)."""
+    return buf.at[_idx(buf, t, lo, contrib.shape[0])].add(contrib)
+
+
+def ring_push_max(buf, t, lo: int, contrib):
+    """Scatter-max (for value channels where 0 == empty)."""
+    return buf.at[_idx(buf, t, lo, contrib.shape[0])].max(contrib)

@@ -1,0 +1,66 @@
+"""Simulation runner: the tensorized replacement for ``Simulator::Run``.
+
+The reference drives everything through ns-3's serial event dispatch
+(blockchain-simulator.cc:57; SURVEY.md §3.1 "THE hot loop").  Here the whole
+simulation is one ``jax.lax.scan`` over ticks, compiled once by XLA: per tick,
+every node's FSM transition and every in-flight message delivery happen as
+batched tensor ops.  Protocol selection is a runtime config field.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+from blockchain_simulator_tpu.models.base import get_protocol
+from blockchain_simulator_tpu.utils import prng
+from blockchain_simulator_tpu.utils.config import SimConfig
+
+
+@functools.lru_cache(maxsize=64)
+def make_sim_fn(cfg: SimConfig):
+    """Build (and cache) the jitted end-to-end simulation function for a config.
+
+    Returns ``sim(key) -> final_state`` running ``cfg.ticks`` ticks.
+    """
+    proto = get_protocol(cfg.protocol)
+
+    @jax.jit
+    def sim(key):
+        state, bufs = proto.init(cfg)
+
+        def body(carry, t):
+            st, bf = carry
+            st, bf = proto.step(cfg, st, bf, t, prng.tick_key(key, t))
+            return (st, bf), ()
+
+        (state, bufs), _ = jax.lax.scan(body, (state, bufs), jnp.arange(cfg.ticks))
+        return state
+
+    return sim
+
+
+def run_simulation(cfg: SimConfig, seed: int | None = None, with_timing: bool = False):
+    """Run one simulation; returns the protocol's structured metrics dict
+    (the reference's NS_LOG lines, SURVEY.md §5, as data)."""
+    proto = get_protocol(cfg.protocol)
+    sim = make_sim_fn(cfg)
+    key = jax.random.key(cfg.seed if seed is None else seed)
+    t0 = time.perf_counter()
+    final = jax.block_until_ready(sim(key))
+    wall = time.perf_counter() - t0
+    m = proto.metrics(cfg, final)
+    if with_timing:
+        m["wallclock_s"] = wall
+        m["ticks"] = cfg.ticks
+    return m
+
+
+def final_state(cfg: SimConfig, seed: int | None = None):
+    """Run and return the raw final state pytree (for tests/checkpointing)."""
+    sim = make_sim_fn(cfg)
+    key = jax.random.key(cfg.seed if seed is None else seed)
+    return jax.block_until_ready(sim(key))

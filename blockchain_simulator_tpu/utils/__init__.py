@@ -1,0 +1,2 @@
+from blockchain_simulator_tpu.utils.config import FaultConfig, SimConfig  # noqa: F401
+from blockchain_simulator_tpu.utils import prng  # noqa: F401

@@ -1,0 +1,210 @@
+"""Typed simulation configuration.
+
+The reference hard-codes every operating constant (see SURVEY.md §5 "Config"):
+N=8 (blockchain-simulator.cc:67), 3 Mbps / 3 ms links (blockchain-simulator.cc:22-24),
+port 7071, PBFT tx_size/tx_speed/timeout (pbft-node.cc:102-107), Raft election
+window / heartbeat (raft-node.cc:69-72,80), Paxos proposer set {0,1,2}
+(paxos-node.cc:136), per-protocol random send delays, stop thresholds 40/50
+blocks.  Every one of those numbers is a field here, with the reference value
+as the default.
+
+Time is discretized into 1 ms ticks (fine enough to resolve the 0-6 ms /
+0-50 ms delay distributions and the 50 ms timers of the reference).
+All delay fields are expressed in ticks (= ms).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultConfig:
+    """Fault-injection configuration (a capability the reference lacks entirely;
+    its only fault-like mechanisms are PBFT's random view change, pbft-node.cc:401-403,
+    and Raft's election timeout, raft-node.cc:114).
+
+    All masks are derived deterministically from the seed at init time.
+    """
+
+    # Fraction of nodes that are crashed from t=0 (never send, never process).
+    crash_frac: float = 0.0
+    # Number of crashed nodes (overrides crash_frac when >= 0). Crashed nodes
+    # are chosen as the *last* ids so proposers/leader-0 stay alive by default.
+    n_crashed: int = -1
+    # Per-message drop probability on every edge.
+    drop_prob: float = 0.0
+    # Number of Byzantine nodes (vote-flippers): their SUCCESS votes are
+    # delivered as FAILED and vice versa. Chosen as the last ids.
+    n_byzantine: int = 0
+
+    def resolved_n_crashed(self, n: int) -> int:
+        if self.n_crashed >= 0:
+            return min(self.n_crashed, n)
+        return int(self.crash_frac * n)
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Full, hashable (static under jit) simulation configuration."""
+
+    # --- core ---------------------------------------------------------------
+    protocol: str = "pbft"  # runtime-selectable (the reference's compile-time
+    # switch at network-helper.cc:17 becomes a flag; SURVEY.md §1)
+    n: int = 8  # cluster size (blockchain-simulator.cc:67)
+    sim_ms: int = 10_000  # app window 0-10 s (blockchain-simulator.cc:54-55)
+    seed: int = 0
+
+    # --- network model ------------------------------------------------------
+    link_delay_ms: int = 3  # p2p channel Delay (blockchain-simulator.cc:24)
+    link_rate_mbps: float = 3.0  # p2p channel DataRate (blockchain-simulator.cc:23)
+    # If True, add ceil(bytes*8/rate) serialization time to block-size messages.
+    # Default False: the reference's 50 KB blocks at 3 Mbps would saturate the
+    # links (136 ms serialization vs 50 ms interval, unbounded ns-3 queues); we
+    # model propagation + the explicit random scheduling delay only, and expose
+    # serialization as an opt-in refinement.
+    model_serialization: bool = False
+
+    # --- topology -----------------------------------------------------------
+    topology: str = "full"  # "full" (reference, blockchain-simulator.cc:34-51)
+    # or "kregular" (random k-regular gossip graph for 10k+ nodes)
+    degree: int = 16  # gossip degree when topology == "kregular"
+
+    # --- execution backend --------------------------------------------------
+    # "edge": exact per-edge delay sampling (O(N^2) work per active tick).
+    # "stat": statistically-exact aggregated delivery — per-receiver bucket
+    #         counts drawn from binomial/multinomial chains (O(N·B)); valid for
+    #         full-mesh count-consumed channels; the 100k-node path.
+    delivery: str = "edge"
+    # "reference": replicate the reference's observable quirks (N/2 thresholds,
+    #              reset-on-threshold vote counters, never-re-armed Raft
+    #              election timer, N-2 Paxos reply counting).
+    # "clean":     documented fixes (latched commits, re-armed timers, N-1
+    #              counting, highest-command adoption).
+    fidelity: str = "clean"
+
+    # --- PBFT (pbft-node.cc) -------------------------------------------------
+    pbft_block_interval_ms: int = 50  # timeout=0.05 (pbft-node.cc:106)
+    pbft_max_rounds: int = 40  # stop at n_round==40 (pbft-node.cc:407)
+    pbft_tx_size: int = 1000  # 1 KB per tx (pbft-node.cc:104)
+    pbft_tx_speed: int = 1000  # 1000 tx/s (pbft-node.cc:105)
+    pbft_delay_lo: int = 3  # random send delay U{3,4,5} ms
+    pbft_delay_hi: int = 6  # (pbft-node.cc:66-69), exclusive
+    pbft_view_change_num: int = 1  # P(view change) = num/den per leader round
+    pbft_view_change_den: int = 100  # (rand()%100==5, pbft-node.cc:401)
+    pbft_max_slots: int = 64  # vote-table slots (tx[1000], pbft-node.h:50; 40
+    # rounds only ever touch slots 0..39)
+
+    # --- Raft (raft-node.cc) -------------------------------------------------
+    raft_heartbeat_ms: int = 50  # heartbeat_timeout=0.05 (raft-node.cc:80)
+    raft_election_lo_ms: int = 150  # election timeout U[150,300) ms
+    raft_election_hi_ms: int = 300  # (raft-node.cc:69-72)
+    raft_delay_lo: int = 0  # random send delay U{0,1,2} ms
+    raft_delay_hi: int = 3  # (raft-node.cc:63-66), exclusive
+    raft_proposal_delay_ms: int = 1000  # proposals start 1 s after election
+    # (raft-node.cc:216)
+    raft_max_blocks: int = 50  # stop at blockNum>=50 (raft-node.cc:248)
+    raft_max_rounds: int = 50  # stop proposals at round==50 (raft-node.cc:361)
+    raft_tx_size: int = 200  # 200 B per tx (raft-node.cc:23)
+    raft_tx_speed: int = 2000  # 2000 tx/s (raft-node.cc:24)
+
+    # --- Paxos (paxos-node.cc) -----------------------------------------------
+    paxos_delay_lo: int = 0  # random send delay U[0,50) ms
+    paxos_delay_hi: int = 50  # (paxos-node.cc:397-400), exclusive
+    paxos_n_proposers: int = 3  # nodes 0,1,2 propose at t=0 (paxos-node.cc:136)
+    paxos_max_ticket: int = 120  # ticket values are single bytes in the
+    # reference codec ('0'+t, paxos-node.cc:49-51); cap retries
+
+    # --- faults --------------------------------------------------------------
+    faults: FaultConfig = dataclasses.field(default_factory=FaultConfig)
+
+    # --- sharding ------------------------------------------------------------
+    # Name of the mesh axis over which node state is sharded (None = unsharded).
+    mesh_axis: Optional[str] = None
+
+    # ------------------------------------------------------------------------
+    def __post_init__(self):
+        if self.protocol not in ("pbft", "raft", "paxos"):
+            raise ValueError(f"unknown protocol {self.protocol!r}")
+        if self.delivery not in ("edge", "stat"):
+            raise ValueError(f"unknown delivery mode {self.delivery!r}")
+        if self.fidelity not in ("reference", "clean"):
+            raise ValueError(f"unknown fidelity {self.fidelity!r}")
+        if self.topology not in ("full", "kregular"):
+            raise ValueError(f"unknown topology {self.topology!r}")
+
+    # --- derived quantities (plain python; all static under jit) ------------
+    @property
+    def ticks(self) -> int:
+        """Total simulation ticks (1 tick = 1 ms)."""
+        return self.sim_ms
+
+    def one_way_range(self) -> tuple[int, int]:
+        """[lo, hi) one-way message delay in ticks: link propagation + the
+        protocol's explicit random scheduling delay (SURVEY.md §3.5 notes the
+        double delay: Simulator::Schedule(getRandomDelay) + channel Delay)."""
+        if self.protocol == "pbft":
+            lo, hi = self.pbft_delay_lo, self.pbft_delay_hi
+        elif self.protocol == "raft":
+            lo, hi = self.raft_delay_lo, self.raft_delay_hi
+        else:
+            lo, hi = self.paxos_delay_lo, self.paxos_delay_hi
+        d = self.link_delay_ms
+        lo, hi = lo + d, hi + d
+        if lo < 1:  # a message can never arrive in the tick it was sent
+            lo, hi = 1, max(hi, 2)
+        return lo, hi
+
+    def roundtrip_range(self) -> tuple[int, int]:
+        """[lo, hi) request+reply delay (reply is processed instantly at the
+        peer and travels back with an independent random delay)."""
+        lo, hi = self.one_way_range()
+        return 2 * lo, 2 * hi - 1
+
+    @property
+    def ring_depth(self) -> int:
+        """Ring-buffer depth: must exceed the maximum scheduling horizon —
+        the round-trip tail or, with serialization modeled, a one-way
+        block-sized message (50 KB at 3 Mbps ≈ 134 ticks)."""
+        _, rt_hi = self.roundtrip_range()
+        _, hi = self.one_way_range()
+        if self.protocol == "pbft":
+            biggest = self.pbft_block_bytes
+        elif self.protocol == "raft":
+            biggest = self.raft_block_bytes
+        else:
+            biggest = 4
+        return max(rt_hi, hi + self.serialization_ticks(biggest)) + 1
+
+    @property
+    def quorum(self) -> int:
+        """The reference's majority threshold N/2 (pbft-node.cc:231,248;
+        raft-node.cc:209; paxos-node.cc:259) — integer division, *not* 2f+1."""
+        return self.n // 2
+
+    @property
+    def pbft_block_txs(self) -> int:
+        # num = tx_speed / (1000/(timeout*1000))  (pbft-node.cc:377)
+        return self.pbft_tx_speed * self.pbft_block_interval_ms // 1000
+
+    @property
+    def pbft_block_bytes(self) -> int:
+        return self.pbft_block_txs * self.pbft_tx_size  # 50 KB
+
+    @property
+    def raft_block_txs(self) -> int:
+        # num = tx_speed / (1000/(heartbeat_timeout*1000)) (raft-node.cc:409)
+        return self.raft_tx_speed * self.raft_heartbeat_ms // 1000
+
+    @property
+    def raft_block_bytes(self) -> int:
+        return self.raft_block_txs * self.raft_tx_size  # 20 KB
+
+    def serialization_ticks(self, nbytes: int) -> int:
+        if not self.model_serialization:
+            return 0
+        return int(nbytes * 8 / (self.link_rate_mbps * 1e6) * 1000 + 0.999)
+
+    def with_(self, **kw) -> "SimConfig":
+        return dataclasses.replace(self, **kw)

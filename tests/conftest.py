@@ -1,0 +1,26 @@
+"""Test configuration: force the CPU backend with 8 virtual devices.
+
+The reference needs no cluster because ns-3 simulates all N nodes in one
+process (SURVEY.md §4); likewise these tests need no TPU — the JAX CPU backend
+with a virtual 8-device mesh exercises every code path including sharding.
+
+Two layers of platform forcing are needed:
+- ``XLA_FLAGS`` must be set before jax import (host device count is read at
+  backend init).
+- this environment's sitecustomize registers a TPU-tunnel PJRT plugin at
+  interpreter start and forces ``jax_platforms="axon,cpu"`` at the *config*
+  level, so the env var alone is not enough — override the config after
+  import, before any backend is initialized.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu"

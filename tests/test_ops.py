@@ -1,0 +1,140 @@
+"""Unit tests for the transport substrate (delay models, rings, delivery)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from blockchain_simulator_tpu.ops import delay as delay_ops
+from blockchain_simulator_tpu.ops import delivery as dv
+from blockchain_simulator_tpu.ops.ring import ring_pop, ring_push_add, ring_push_max
+
+
+def test_uniform_probs():
+    p = delay_ops.uniform_probs(3, 6)
+    assert p.shape == (3,)
+    np.testing.assert_allclose(p.sum(), 1.0)
+
+
+def test_roundtrip_probs_support():
+    # sum of two U{3..5}: support 6..10, triangular
+    p = delay_ops.roundtrip_probs(3, 6)
+    assert p.shape == (5,)
+    np.testing.assert_allclose(p.sum(), 1.0)
+    np.testing.assert_allclose(p[2], 3 / 9)  # mode at 8
+
+
+def test_edge_delays_in_range():
+    d = delay_ops.sample_edge_delays(jax.random.key(0), (50, 50), 3, 6)
+    assert int(d.min()) >= 3 and int(d.max()) <= 5
+
+
+def test_bucket_counts_conserve_total():
+    probs = delay_ops.roundtrip_probs(0, 3)
+    n = jnp.array([[7, 0], [100, 3]], jnp.int32)
+    c = delay_ops.sample_bucket_counts(jax.random.key(1), n, probs)
+    assert c.shape == (len(probs), 2, 2)
+    np.testing.assert_array_equal(np.asarray(c.sum(0)), np.asarray(n))
+    assert int(c.min()) >= 0
+
+
+def test_bucket_counts_distribution():
+    probs = delay_ops.uniform_probs(0, 4)
+    n = jnp.full((2000,), 40, jnp.int32)
+    c = delay_ops.sample_bucket_counts(jax.random.key(2), n, probs)
+    frac = np.asarray(c.sum(1) / c.sum())
+    np.testing.assert_allclose(frac, 0.25, atol=0.01)
+
+
+def test_ring_push_pop_timing():
+    buf = jnp.zeros((8, 4), jnp.int32)
+    contrib = jnp.stack([jnp.full((4,), b + 1, jnp.int32) for b in range(3)])
+    buf = ring_push_add(buf, 2, 3, contrib)  # lands at ticks 5,6,7
+    for t in (3, 4):
+        got, buf = ring_pop(buf, t)
+        assert int(got.sum()) == 0
+    for i, t in enumerate((5, 6, 7)):
+        got, buf = ring_pop(buf, t)
+        np.testing.assert_array_equal(np.asarray(got), i + 1)
+    # pop clears: wrap around and check emptiness
+    got, buf = ring_pop(buf, 5 + 8)
+    assert int(got.sum()) == 0
+
+
+def test_ring_wraparound():
+    buf = jnp.zeros((4, 1), jnp.int32)
+    buf = ring_push_add(buf, 6, 3, jnp.ones((1, 1), jnp.int32))  # tick 9 -> idx 1
+    got, buf = ring_pop(buf, 9)
+    assert int(got[0]) == 1
+
+
+def test_ring_push_max_combines():
+    buf = jnp.zeros((8, 2), jnp.int32)
+    buf = ring_push_max(buf, 0, 2, jnp.array([[5, 1]], jnp.int32))
+    buf = ring_push_max(buf, 0, 2, jnp.array([[3, 9]], jnp.int32))
+    got, _ = ring_pop(buf, 2)
+    np.testing.assert_array_equal(np.asarray(got), [5, 9])
+
+
+def test_bcast_counts_dense_totals():
+    n = 16
+    send = jnp.zeros((n,), bool).at[jnp.array([0, 5])].set(True)
+    c = dv.bcast_counts_dense(jax.random.key(3), send, 3, 6)
+    total = np.asarray(c.sum(0))
+    # every non-sender receives 2, senders receive 1 (not from self)
+    assert total[0] == 1 and total[5] == 1
+    assert (np.delete(total, [0, 5]) == 2).all()
+
+
+def test_bcast_slots_dense_slot_routing():
+    n, s = 8, 4
+    slot_mat = jnp.zeros((n, s), jnp.int32).at[2, 3].set(1)
+    c = dv.bcast_slots_dense(jax.random.key(4), slot_mat, 3, 6)
+    total = np.asarray(c.sum(0))  # [N, S]
+    assert (total[:, :3] == 0).all()
+    assert total[2, 3] == 0  # sender does not hear itself
+    assert (np.delete(total[:, 3], 2) == 1).all()
+
+
+def test_roundtrip_reply_counts_dense():
+    n = 10
+    send = jnp.zeros((n,), bool).at[4].set(True)
+    c = dv.roundtrip_reply_counts_dense(jax.random.key(5), send, 3, 6)
+    total = np.asarray(c.sum(0))
+    assert total[4] == n - 1 and np.delete(total, 4).sum() == 0
+
+
+def test_roundtrip_peer_mask_excludes_byzantine():
+    n = 10
+    send = jnp.zeros((n,), bool).at[0].set(True)
+    peers = jnp.arange(n) < 7  # 3 byzantine/crashed peers don't vote
+    c = dv.roundtrip_reply_counts_dense(jax.random.key(6), send, 3, 6, peer_mask=peers)
+    assert int(c.sum()) == 6  # peers 1..6
+
+
+def test_stat_matches_dense_totals():
+    n = 64
+    send = jnp.ones((n,), bool)
+    probs = delay_ops.uniform_probs(3, 6)
+    c = dv.bcast_counts_stat(jax.random.key(7), n, send, probs)
+    total = np.asarray(c.sum(0))
+    assert (total == n - 1).all()
+
+
+def test_bcast_matrix_dense_identity():
+    n = 6
+    send = jnp.zeros((n,), bool).at[1].set(True)
+    value = jnp.zeros((n,), jnp.int32).at[1].set(42)
+    c = dv.bcast_matrix_dense(jax.random.key(8), send, value, 3, 6)
+    total = np.asarray(c.max(0))  # [recv, send]
+    assert (total[:, [0, 2, 3, 4, 5]] == 0).all()
+    assert total[1, 1] == 0
+    assert sorted(np.unique(total[:, 1]).tolist()) in ([0, 42], [[0, 42]], [0, 42])
+
+
+def test_drop_prob_thins_traffic():
+    n = 32
+    send = jnp.ones((n,), bool)
+    c_full = dv.bcast_counts_dense(jax.random.key(9), send, 3, 6, 0.0)
+    c_half = dv.bcast_counts_dense(jax.random.key(9), send, 3, 6, 0.5)
+    assert int(c_half.sum()) < int(c_full.sum())

@@ -98,13 +98,18 @@ def init(cfg, key=None):
     return state, bufs
 
 
-def _gated(pred, fn, zeros):
-    """Skip a delivery computation when no sender is active this tick."""
+def _gated(pred, fn, zeros, axis=None):
+    """Skip a delivery computation when no sender is active this tick.
+    Sharded, the predicate must be globally agreed (the branch contains
+    collectives), so it is pmax-reduced over the mesh axis first."""
+    if axis is not None:
+        pred = jax.lax.pmax(pred.astype(jnp.int32), axis) > 0
     return jax.lax.cond(pred, fn, lambda: zeros)
 
 
 def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     n, s = cfg.n, cfg.pbft_max_slots
+    axis = cfg.mesh_axis
     lo, hi = cfg.one_way_range()
     rt_lo, rt_hi = cfg.roundtrip_range()
     drop = cfg.faults.drop_prob
@@ -112,7 +117,9 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     stat = cfg.delivery == "stat"
     ow_probs = delay_ops.uniform_probs(lo, hi)
     rt_probs = delay_ops.roundtrip_probs(lo, hi)
-    ids = jnp.arange(n)
+    n_loc = state.v.shape[0]
+    # global node ids of this shard's rows (== arange(N) unsharded)
+    ids = dv._global_ids(n_loc, axis)
     slots = jnp.arange(s)
 
     # ---- pop this tick's arrivals; crashed nodes process nothing ------------
@@ -144,20 +151,25 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     prep_active = got_pp.any(axis=1)
     if stat:
         n_voters = voters.astype(jnp.int32).sum()
+        if axis is not None:
+            n_voters = jax.lax.psum(n_voters, axis)
         rt_counts = _gated(
             prep_active.any(),
             lambda: dv.roundtrip_reply_counts_stat(
-                k_rt, prep_active, n_voters - voters.astype(jnp.int32), rt_probs, drop
+                k_rt, prep_active, n_voters - voters.astype(jnp.int32), rt_probs,
+                drop, axis=axis,
             ),
-            jnp.zeros((len(rt_probs), n), jnp.int32),
+            jnp.zeros((len(rt_probs), n_loc), jnp.int32),
+            axis,
         )
     else:
         rt_counts = _gated(
             prep_active.any(),
             lambda: dv.roundtrip_reply_counts_dense(
-                k_rt, prep_active, lo, hi, drop, peer_mask=voters
+                k_rt, prep_active, lo, hi, drop, peer_mask=voters, axis=axis
             ),
-            jnp.zeros((len(rt_probs), n), jnp.int32),
+            jnp.zeros((len(rt_probs), n_loc), jnp.int32),
+            axis,
         )
     # replies are per broadcast, i.e. per active (node, slot)
     prep_rt = ring_push_add(
@@ -174,18 +186,20 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
 
     commit_send = crossed_p & (state.alive & state.honest)[:, None]
     k_cm = chan_key(tkey, Channel.DELAY_BCAST)
-    zeros_slots = jnp.zeros((hi - lo, n, s), jnp.int32)
+    zeros_slots = jnp.zeros((hi - lo, n_loc, s), jnp.int32)
     if stat:
         cm_contrib = _gated(
             commit_send.any(),
-            lambda: dv.bcast_slots_stat(k_cm, commit_send, ow_probs, drop),
+            lambda: dv.bcast_slots_stat(k_cm, commit_send, ow_probs, drop, axis=axis),
             zeros_slots,
+            axis,
         )
     else:
         cm_contrib = _gated(
             commit_send.any(),
-            lambda: dv.bcast_slots_dense(k_cm, commit_send, lo, hi, drop),
+            lambda: dv.bcast_slots_dense(k_cm, commit_send, lo, hi, drop, axis=axis),
             zeros_slots,
+            axis,
         )
     commit = ring_push_add(commit, t, lo, cm_contrib)
 
@@ -220,23 +234,26 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     if stat:
         pp_contrib = _gated(
             send_block.any(),
-            lambda: dv.bcast_slots_stat(k_pp, pp_slot_mat, ow_probs, drop),
+            lambda: dv.bcast_slots_stat(k_pp, pp_slot_mat, ow_probs, drop, axis=axis),
             zeros_slots,
+            axis,
         )
     else:
         pp_contrib = _gated(
             send_block.any(),
-            lambda: dv.bcast_slots_dense(k_pp, pp_slot_mat, lo, hi, drop),
+            lambda: dv.bcast_slots_dense(k_pp, pp_slot_mat, lo, hi, drop, axis=axis),
             zeros_slots,
+            axis,
         )
     pp = ring_push_add(pp, t, lo + ser, pp_contrib)
     rounds_sent = state.rounds_sent + send_block
     next_n = next_n + send_block
 
     # ---- random view change (P = 1/100 per leader round) --------------------
-    u = jax.random.randint(
-        chan_key(tkey, Channel.VIEW_CHANGE), (n,), 0, cfg.pbft_view_change_den
-    )
+    k_u = chan_key(tkey, Channel.VIEW_CHANGE)
+    if axis is not None:
+        k_u = jax.random.fold_in(k_u, jax.lax.axis_index(axis))
+    u = jax.random.randint(k_u, (n_loc,), 0, cfg.pbft_view_change_den)
     trigger = send_block & (u < cfg.pbft_view_change_num)
     new_leader = (leader + 1) % n  # rotation (pbft-node.cc:297)
     new_v = v + 1
@@ -245,18 +262,20 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     view_changes = state.view_changes + trigger
     enc = jnp.where(trigger, new_v * n + new_leader + 1, 0)
     k_vc = chan_key(tkey, Channel.DELAY_REPLY)
-    zeros_flat = jnp.zeros((hi - lo, n), jnp.int32)
+    zeros_flat = jnp.zeros((hi - lo, n_loc), jnp.int32)
     if stat:
         vc_contrib = _gated(
             trigger.any(),
-            lambda: dv.bcast_value_max_stat(k_vc, enc, ow_probs, drop),
+            lambda: dv.bcast_value_max_stat(k_vc, enc, ow_probs, drop, axis=axis),
             zeros_flat,
+            axis,
         )
     else:
         vc_contrib = _gated(
             trigger.any(),
-            lambda: dv.bcast_value_max_dense(k_vc, trigger, enc, lo, hi, drop),
+            lambda: dv.bcast_value_max_dense(k_vc, trigger, enc, lo, hi, drop, axis=axis),
             zeros_flat,
+            axis,
         )
     vc = ring_push_max(vc, t, lo, vc_contrib)
 

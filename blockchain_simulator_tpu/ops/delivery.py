@@ -7,10 +7,17 @@ distribution's support (offset ``lo``).  The reference's per-message
 exact per-edge sample (*dense*) or a statistically exact per-receiver bucket
 count (*stat*, for full-mesh count-consumed channels at large N).
 
+SPMD: every function takes ``axis`` — the name of a mesh axis over which the
+node dimension is sharded (None = unsharded).  Inside ``shard_map`` the
+receiver axis stays local while sender-side quantities are globalized with XLA
+collectives (``all_gather`` for masks/values, ``psum`` for totals); this is the
+TPU-native replacement for the reference's simulated UDP fan-out
+(pbft-node.cc:350-368) — message exchange rides ICI, not a socket model.
+
 Conventions: senders never deliver to themselves (the reference's peer lists
-exclude self, network-helper.cc / blockchain-simulator.cc:44-45); ``send`` masks
-are already fault-masked by the caller; ``drop_prob`` models lossy edges (a
-capability absent in the reference — its simulated links never drop).
+exclude self, blockchain-simulator.cc:44-45); ``send`` masks are already
+fault-masked by the caller; ``drop_prob`` models lossy edges (a capability
+absent in the reference — its simulated links never drop).
 """
 
 from __future__ import annotations
@@ -18,18 +25,48 @@ from __future__ import annotations
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from blockchain_simulator_tpu.ops.delay import sample_bucket_counts, sample_edge_delays
 
 
-def _edge_hits(key, send, lo: int, hi: int, drop_prob: float = 0.0):
-    """[B, N_send, N_recv] 0/1 delivery indicators, self-edges removed."""
-    n = send.shape[0]
-    d = sample_edge_delays(key, (n, n), lo, hi)
-    mask = send.astype(jnp.int32)[:, None] * (1 - jnp.eye(n, dtype=jnp.int32))
+def _shard_key(key, axis):
+    """Decorrelate per-shard sampling (each edge must be drawn exactly once,
+    by the shard that consumes it)."""
+    if axis is None:
+        return key
+    return jax.random.fold_in(key, lax.axis_index(axis))
+
+
+def _gather(x, axis):
+    """Local [n_loc, ...] -> global [N, ...] along the node axis."""
+    if axis is None:
+        return x
+    return lax.all_gather(x, axis, tiled=True)
+
+
+def _global_ids(n_loc: int, axis):
+    """Global node ids of this shard's rows."""
+    base = 0 if axis is None else lax.axis_index(axis) * n_loc
+    return base + jnp.arange(n_loc)
+
+
+def _edge_hits(key, send, lo: int, hi: int, drop_prob: float = 0.0, axis=None,
+               send_global=None):
+    """[B, N_send_global, N_recv_local] 0/1 delivery indicators, self-edges
+    removed.  Delays are sampled receiver-side (each edge's delay is consumed
+    by exactly one shard, so per-shard independent draws are exact).
+    ``send_global`` lets callers reuse an already-gathered sender mask."""
+    n_loc = send.shape[0]
+    send_g = _gather(send, axis) if send_global is None else send_global
+    n_glob = send_g.shape[0]
+    k = _shard_key(key, axis)
+    d = sample_edge_delays(k, (n_glob, n_loc), lo, hi)
+    notself = (jnp.arange(n_glob)[:, None] != _global_ids(n_loc, axis)[None, :])
+    mask = send_g.astype(jnp.int32)[:, None] * notself.astype(jnp.int32)
     if drop_prob > 0.0:
         keep = jax.random.bernoulli(
-            jax.random.fold_in(key, 0x0D0D), 1.0 - drop_prob, (n, n)
+            jax.random.fold_in(k, 0x0D0D), 1.0 - drop_prob, (n_glob, n_loc)
         )
         mask = mask * keep.astype(jnp.int32)
     return jnp.stack([(d == lo + b).astype(jnp.int32) * mask for b in range(hi - lo)])
@@ -40,50 +77,67 @@ def _edge_hits(key, send, lo: int, hi: int, drop_prob: float = 0.0):
 # --------------------------------------------------------------------------- #
 
 
-def bcast_counts_dense(key, send, lo, hi, drop_prob=0.0):
-    """Broadcast → per-receiver arrival counts.  Returns [B, N]."""
-    return _edge_hits(key, send, lo, hi, drop_prob).sum(1)
+def bcast_counts_dense(key, send, lo, hi, drop_prob=0.0, axis=None):
+    """Broadcast → per-receiver arrival counts.  Returns [B, N_loc]."""
+    return _edge_hits(key, send, lo, hi, drop_prob, axis).sum(1)
 
 
-def bcast_value_max_dense(key, send, value, lo, hi, drop_prob=0.0):
+def bcast_value_max_dense(key, send, value, lo, hi, drop_prob=0.0, axis=None):
     """Broadcast of a per-sender value (>0; 0 = empty), max-combined at the
-    receiver.  Returns [B, N]."""
-    hits = _edge_hits(key, send, lo, hi, drop_prob)
-    return (hits * value.astype(jnp.int32)[None, :, None]).max(1)
+    receiver.  Returns [B, N_loc]."""
+    hits = _edge_hits(key, send, lo, hi, drop_prob, axis)
+    value_g = _gather(value, axis)
+    return (hits * value_g.astype(jnp.int32)[None, :, None]).max(1)
 
 
-def bcast_slots_dense(key, slot_mat, lo, hi, drop_prob=0.0):
+def bcast_slots_dense(key, slot_mat, lo, hi, drop_prob=0.0, axis=None):
     """Slot-keyed broadcast (e.g. PBFT messages carrying seq no n): sender i
     broadcasts one message per active slot in ``slot_mat[i, s]`` (0/1).
-    Returns arrival counts per (receiver, slot): [B, N, S].
+    Returns arrival counts per (receiver, slot): [B, N_loc, S].
 
     Note: when a sender is active in several slots in the same tick, those
     broadcasts share one delay draw per edge (a documented simplification; the
     reference draws per message, pbft-node.cc:364)."""
+    slot_g = _gather(slot_mat.astype(jnp.int32), axis)
     send = slot_mat.max(axis=1)
-    hits = _edge_hits(key, send, lo, hi, drop_prob)  # [B, N, N]
-    return jnp.einsum("bij,is->bjs", hits, slot_mat.astype(jnp.int32))
+    hits = _edge_hits(
+        key, send, lo, hi, drop_prob, axis, send_global=slot_g.max(axis=1)
+    )  # [B, N_glob, N_loc]
+    return jnp.einsum("bij,is->bjs", hits, slot_g)
 
 
-def roundtrip_reply_counts_dense(key, send, lo, hi, drop_prob=0.0, peer_mask=None):
+def roundtrip_reply_counts_dense(
+    key, send, lo, hi, drop_prob=0.0, peer_mask=None, axis=None
+):
     """Short-circuited request/reply round trip: sender i broadcasts, every
     peer replies unconditionally and instantly, the reply travels back with an
     independent delay.  Used where the peer's state does not affect the reply
     (PBFT PREPARE → PREPARE_RES SUCCESS, pbft-node.cc:212-221; Raft HEARTBEAT →
-    HEARTBEAT_RES SUCCESS, raft-node.cc:170-193).  ``peer_mask`` restricts which
-    peers reply (crashed/Byzantine exclusion).  Returns reply counts at the
-    original sender: [B2, N], offset 2*lo, B2 = 2*(hi-lo)-1."""
-    n = send.shape[0]
-    d1 = sample_edge_delays(jax.random.fold_in(key, 1), (n, n), lo, hi)
-    d2 = sample_edge_delays(jax.random.fold_in(key, 2), (n, n), lo, hi)
+    HEARTBEAT_RES SUCCESS, raft-node.cc:170-193).  ``peer_mask`` (local
+    [n_loc]) restricts which peers reply (crashed/Byzantine exclusion).
+    Returns reply counts at the original (local) sender: [B2, N_loc],
+    offset 2*lo, B2 = 2*(hi-lo)-1.
+
+    Sharded: the *sender* consumes both legs' delays, so delays are sampled
+    sender-side over the gathered peer axis."""
+    n_loc = send.shape[0]
+    peers = jnp.ones((n_loc,), bool) if peer_mask is None else peer_mask
+    peers_g = _gather(peers, axis)
+    n_glob = peers_g.shape[0]
+    k = _shard_key(key, axis)
+    d1 = sample_edge_delays(jax.random.fold_in(k, 1), (n_loc, n_glob), lo, hi)
+    d2 = sample_edge_delays(jax.random.fold_in(k, 2), (n_loc, n_glob), lo, hi)
     total = d1 + d2  # delay until the reply reaches the sender
-    mask = send.astype(jnp.int32)[:, None] * (1 - jnp.eye(n, dtype=jnp.int32))
-    if peer_mask is not None:
-        mask = mask * peer_mask.astype(jnp.int32)[None, :]
+    notself = (_global_ids(n_loc, axis)[:, None] != jnp.arange(n_glob)[None, :])
+    mask = (
+        send.astype(jnp.int32)[:, None]
+        * notself.astype(jnp.int32)
+        * peers_g.astype(jnp.int32)[None, :]
+    )
     if drop_prob > 0.0:
         # either leg can drop
         keep = jax.random.bernoulli(
-            jax.random.fold_in(key, 0x0D0E), (1.0 - drop_prob) ** 2, (n, n)
+            jax.random.fold_in(k, 0x0D0E), (1.0 - drop_prob) ** 2, (n_loc, n_glob)
         )
         mask = mask * keep.astype(jnp.int32)
     lo2 = 2 * lo
@@ -93,29 +147,41 @@ def roundtrip_reply_counts_dense(key, send, lo, hi, drop_prob=0.0, peer_mask=Non
     )
 
 
-def unicast_reply_counts_dense(key, reply, lo, hi, drop_prob=0.0):
+def unicast_reply_counts_dense(key, reply, lo, hi, drop_prob=0.0, axis=None):
     """Route per-(replier, requester) reply counts back to each requester.
-    ``reply[r, c]`` = number of (identical, count-consumed) replies node r
-    sends node c this tick.  Returns [B, N] indexed by requester c."""
-    n = reply.shape[0]
-    d = sample_edge_delays(key, (n, n), lo, hi)
-    mask = 1 - jnp.eye(n, dtype=jnp.int32)
+    ``reply[r, c]`` = number of (identical, count-consumed) replies local
+    node r sends global node c this tick.  Returns [B, N_loc] indexed by
+    *local* requester — sharded, the contribution must be summed across
+    shards (the repliers), which is a ``psum`` over the axis."""
+    n_loc, n_glob = reply.shape
+    k = _shard_key(key, axis)
+    d = sample_edge_delays(k, (n_loc, n_glob), lo, hi)
+    notself = (_global_ids(n_loc, axis)[:, None] != jnp.arange(n_glob)[None, :])
+    mask = notself.astype(jnp.int32)
     if drop_prob > 0.0:
         keep = jax.random.bernoulli(
-            jax.random.fold_in(key, 0x0D0F), 1.0 - drop_prob, (n, n)
+            jax.random.fold_in(k, 0x0D0F), 1.0 - drop_prob, (n_loc, n_glob)
         )
         mask = mask * keep.astype(jnp.int32)
     r = reply.astype(jnp.int32) * mask
-    return jnp.stack([(r * (d == lo + b)).sum(0) for b in range(hi - lo)])
+    out_g = jnp.stack([(r * (d == lo + b)).sum(0) for b in range(hi - lo)])  # [B, N_glob]
+    if axis is None:
+        return out_g
+    out_g = lax.psum(out_g, axis)
+    # slice this shard's requesters
+    start = lax.axis_index(axis) * n_loc
+    return lax.dynamic_slice_in_dim(out_g, start, n_loc, axis=1)
 
 
-def bcast_matrix_dense(key, send, value, lo, hi, drop_prob=0.0):
+def bcast_matrix_dense(key, send, value, lo, hi, drop_prob=0.0, axis=None):
     """Identity-preserving broadcast for request channels whose handling
     depends on receiver state at arrival (Raft VOTE_REQ, Paxos REQUEST_*).
-    ``value`` (>0 per sender; 0 = empty) lands at ``[b, receiver, sender]``.
-    Returns [B, N, N] (max-combined into a matrix ring)."""
-    hits = _edge_hits(key, send, lo, hi, drop_prob)  # [B, send, recv]
-    return jnp.swapaxes(hits * value.astype(jnp.int32)[None, :, None], 1, 2)
+    ``value`` (>0 per sender; 0 = empty) lands at ``[b, receiver_local,
+    sender_global]``.  Returns [B, N_loc, N_glob] (max-combined into a matrix
+    ring)."""
+    hits = _edge_hits(key, send, lo, hi, drop_prob, axis)  # [B, glob, loc]
+    value_g = _gather(value, axis)
+    return jnp.swapaxes(hits * value_g.astype(jnp.int32)[None, :, None], 1, 2)
 
 
 # --------------------------------------------------------------------------- #
@@ -123,49 +189,59 @@ def bcast_matrix_dense(key, send, value, lo, hi, drop_prob=0.0):
 # --------------------------------------------------------------------------- #
 
 
-def bcast_counts_stat(key, n_senders, is_sender, probs: np.ndarray, drop_prob=0.0):
+def bcast_counts_stat(key, n_senders, is_sender, probs: np.ndarray, drop_prob=0.0, axis=None):
     """Full-mesh broadcast arrival counts without materializing edges.
 
     Each receiver j hears from ``n_senders - is_sender[j]`` peers; its arrival
     buckets are Multinomial over the delay distribution, independent across
-    receivers (distinct edges ⇒ independent delays).  Returns [B, N]."""
+    receivers (distinct edges ⇒ independent delays).  ``n_senders`` must be
+    the *global* sender count (psum'ed by the caller when sharded).
+    Returns [B, N_loc]."""
+    k = _shard_key(key, axis)
     m = jnp.asarray(n_senders, jnp.int32) - is_sender.astype(jnp.int32)
     if drop_prob > 0.0:
         m = jnp.round(
             jax.random.binomial(
-                jax.random.fold_in(key, 0x0D10), m.astype(jnp.float32), 1.0 - drop_prob
+                jax.random.fold_in(k, 0x0D10), m.astype(jnp.float32), 1.0 - drop_prob
             )
         ).astype(jnp.int32)
-    return sample_bucket_counts(key, m, probs)
+    return sample_bucket_counts(k, m, probs)
 
 
-def bcast_slots_stat(key, slot_mat, probs: np.ndarray, drop_prob=0.0):
+def bcast_slots_stat(key, slot_mat, probs: np.ndarray, drop_prob=0.0, axis=None):
     """Stat version of bcast_slots_dense: receiver j hears, per slot s,
     from ``(Σ_i slot_mat[i,s]) - slot_mat[j,s]`` senders; arrival buckets are
-    multinomial per (receiver, slot).  Returns [B, N, S]."""
+    multinomial per (receiver, slot).  Returns [B, N_loc, S]."""
+    k = _shard_key(key, axis)
     sm = slot_mat.astype(jnp.int32)
-    m = sm.sum(axis=0)[None, :] - sm  # [N, S]
+    totals = sm.sum(axis=0)
+    if axis is not None:
+        totals = lax.psum(totals, axis)
+    m = totals[None, :] - sm  # [N_loc, S]
     if drop_prob > 0.0:
         m = jnp.round(
             jax.random.binomial(
-                jax.random.fold_in(key, 0x0D12), m.astype(jnp.float32), 1.0 - drop_prob
+                jax.random.fold_in(k, 0x0D12), m.astype(jnp.float32), 1.0 - drop_prob
             )
         ).astype(jnp.int32)
-    return sample_bucket_counts(key, m, probs)
+    return sample_bucket_counts(k, m, probs)
 
 
-def bcast_value_max_stat(key, value, probs: np.ndarray, drop_prob=0.0):
+def bcast_value_max_stat(key, value, probs: np.ndarray, drop_prob=0.0, axis=None):
     """Stat version of bcast_value_max_dense for ≤-a-few senders (e.g. PBFT
     VIEW_CHANGE from the leader): deliver the max announced value to every
-    receiver with one per-receiver delay draw.  Returns [B, N]."""
+    receiver with one per-receiver delay draw.  Returns [B, N_loc]."""
+    k = _shard_key(key, axis)
     n = value.shape[0]
     vmax = value.astype(jnp.int32).max()
+    if axis is not None:
+        vmax = lax.pmax(vmax, axis)
     nb = len(probs)
-    d = jax.random.categorical(key, jnp.log(jnp.asarray(probs) + 1e-30), shape=(n,))
+    d = jax.random.categorical(k, jnp.log(jnp.asarray(probs) + 1e-30), shape=(n,))
     sent = (vmax > 0).astype(jnp.int32)
     if drop_prob > 0.0:
         keep = jax.random.bernoulli(
-            jax.random.fold_in(key, 0x0D13), 1.0 - drop_prob, (n,)
+            jax.random.fold_in(k, 0x0D13), 1.0 - drop_prob, (n,)
         )
         sent = sent * keep.astype(jnp.int32)
     # a node that announced the (same, max) value already applied it locally;
@@ -173,16 +249,19 @@ def bcast_value_max_stat(key, value, probs: np.ndarray, drop_prob=0.0):
     return jnp.stack([(d == b).astype(jnp.int32) * sent * vmax for b in range(nb)])
 
 
-def roundtrip_reply_counts_stat(key, send, n_peers, rt_probs: np.ndarray, drop_prob=0.0):
+def roundtrip_reply_counts_stat(
+    key, send, n_peers, rt_probs: np.ndarray, drop_prob=0.0, axis=None
+):
     """Stat version of roundtrip_reply_counts_dense: each active sender gets
-    ``n_peers`` replies multinomially spread over the round-trip distribution.
-    Returns [B2, N]."""
+    ``n_peers`` (global count, per local sender) replies multinomially spread
+    over the round-trip distribution.  Returns [B2, N_loc]."""
+    k = _shard_key(key, axis)
     m = send.astype(jnp.int32) * jnp.asarray(n_peers, jnp.int32)
     if drop_prob > 0.0:
         p_keep = (1.0 - drop_prob) ** 2
         m = jnp.round(
             jax.random.binomial(
-                jax.random.fold_in(key, 0x0D11), m.astype(jnp.float32), p_keep
+                jax.random.fold_in(k, 0x0D11), m.astype(jnp.float32), p_keep
             )
         ).astype(jnp.int32)
-    return sample_bucket_counts(key, m, rt_probs)
+    return sample_bucket_counts(k, m, rt_probs)

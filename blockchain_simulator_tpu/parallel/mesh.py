@@ -1,0 +1,41 @@
+"""Device mesh construction.
+
+TPU-native replacement for the reference's "distributed backend": where the
+reference fans out over simulated UDP sockets on one CPU thread
+(SURVEY.md §2 parallelism checklist — it has no real parallelism at all),
+this framework shards the node axis of every state/buffer tensor over a
+``jax.sharding.Mesh`` and lets XLA insert ICI collectives.  Axes:
+
+- ``"nodes"``  — node-shard parallelism (SPMD over the simulated cluster).
+- ``"sweep"``  — batch whole simulations (seeds / fault configs).
+
+Multi-host: build the mesh from ``jax.devices()`` after
+``jax.distributed.initialize()`` — the same specs then span DCN.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+NODES_AXIS = "nodes"
+SWEEP_AXIS = "sweep"
+
+
+def make_mesh(n_node_shards: int | None = None, n_sweep: int = 1, devices=None) -> Mesh:
+    """A (sweep, nodes) mesh. Defaults to all available devices on nodes."""
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    if n_node_shards is None:
+        if devices.size % n_sweep != 0:
+            raise ValueError(
+                f"{devices.size} devices not divisible by n_sweep={n_sweep}"
+            )
+        n_node_shards = devices.size // n_sweep
+    if n_sweep * n_node_shards > devices.size:
+        raise ValueError(
+            f"mesh {n_sweep}x{n_node_shards} needs {n_sweep * n_node_shards} "
+            f"devices, only {devices.size} available"
+        )
+    devices = devices[: n_sweep * n_node_shards].reshape(n_sweep, n_node_shards)
+    return Mesh(devices, (SWEEP_AXIS, NODES_AXIS))

@@ -36,6 +36,18 @@ def get_protocol(name: str):
     return m
 
 
+def gated(pred, fn, zeros, axis=None):
+    """Skip a delivery computation when no sender is active this tick.
+    Sharded, the predicate must be globally agreed (the branch contains
+    collectives), so it is pmax-reduced over the mesh axis first."""
+    import jax
+    import jax.numpy as jnp
+
+    if axis is not None:
+        pred = jax.lax.pmax(pred.astype(jnp.int32), axis) > 0
+    return jax.lax.cond(pred, fn, lambda: zeros)
+
+
 def fault_masks(cfg, n: int):
     """(alive[N], honest[N]) bool masks from the fault config.
 

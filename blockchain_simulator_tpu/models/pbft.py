@@ -38,7 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
-from blockchain_simulator_tpu.models.base import fault_masks
+from blockchain_simulator_tpu.models.base import fault_masks, gated
 from blockchain_simulator_tpu.ops import delay as delay_ops
 from blockchain_simulator_tpu.ops import delivery as dv
 from blockchain_simulator_tpu.ops.ring import ring_pop, ring_push_add, ring_push_max
@@ -98,13 +98,6 @@ def init(cfg, key=None):
     return state, bufs
 
 
-def _gated(pred, fn, zeros, axis=None):
-    """Skip a delivery computation when no sender is active this tick.
-    Sharded, the predicate must be globally agreed (the branch contains
-    collectives), so it is pmax-reduced over the mesh axis first."""
-    if axis is not None:
-        pred = jax.lax.pmax(pred.astype(jnp.int32), axis) > 0
-    return jax.lax.cond(pred, fn, lambda: zeros)
 
 
 def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
@@ -153,7 +146,7 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
         n_voters = voters.astype(jnp.int32).sum()
         if axis is not None:
             n_voters = jax.lax.psum(n_voters, axis)
-        rt_counts = _gated(
+        rt_counts = gated(
             prep_active.any(),
             lambda: dv.roundtrip_reply_counts_stat(
                 k_rt, prep_active, n_voters - voters.astype(jnp.int32), rt_probs,
@@ -163,7 +156,7 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
             axis,
         )
     else:
-        rt_counts = _gated(
+        rt_counts = gated(
             prep_active.any(),
             lambda: dv.roundtrip_reply_counts_dense(
                 k_rt, prep_active, lo, hi, drop, peer_mask=voters, axis=axis
@@ -188,14 +181,14 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     k_cm = chan_key(tkey, Channel.DELAY_BCAST)
     zeros_slots = jnp.zeros((hi - lo, n_loc, s), jnp.int32)
     if stat:
-        cm_contrib = _gated(
+        cm_contrib = gated(
             commit_send.any(),
             lambda: dv.bcast_slots_stat(k_cm, commit_send, ow_probs, drop, axis=axis),
             zeros_slots,
             axis,
         )
     else:
-        cm_contrib = _gated(
+        cm_contrib = gated(
             commit_send.any(),
             lambda: dv.bcast_slots_dense(k_cm, commit_send, lo, hi, drop, axis=axis),
             zeros_slots,
@@ -232,14 +225,14 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     ser = cfg.serialization_ticks(cfg.pbft_block_bytes)
     k_pp = chan_key(tkey, Channel.DELAY_BCAST2)
     if stat:
-        pp_contrib = _gated(
+        pp_contrib = gated(
             send_block.any(),
             lambda: dv.bcast_slots_stat(k_pp, pp_slot_mat, ow_probs, drop, axis=axis),
             zeros_slots,
             axis,
         )
     else:
-        pp_contrib = _gated(
+        pp_contrib = gated(
             send_block.any(),
             lambda: dv.bcast_slots_dense(k_pp, pp_slot_mat, lo, hi, drop, axis=axis),
             zeros_slots,
@@ -264,14 +257,14 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     k_vc = chan_key(tkey, Channel.DELAY_REPLY)
     zeros_flat = jnp.zeros((hi - lo, n_loc), jnp.int32)
     if stat:
-        vc_contrib = _gated(
+        vc_contrib = gated(
             trigger.any(),
             lambda: dv.bcast_value_max_stat(k_vc, enc, ow_probs, drop, axis=axis),
             zeros_flat,
             axis,
         )
     else:
-        vc_contrib = _gated(
+        vc_contrib = gated(
             trigger.any(),
             lambda: dv.bcast_value_max_dense(k_vc, trigger, enc, lo, hi, drop, axis=axis),
             zeros_flat,

@@ -1,0 +1,555 @@
+"""Raft(-like) consensus — tensorized state machine.
+
+Re-design of the reference's ``RaftNode`` (raft/raft-node.h:19, raft-node.cc):
+randomized-timeout leader election (150-300 ms, raft-node.cc:69-72,114),
+50 ms heartbeats (raft-node.cc:80,405-429), proposal-carrying heartbeats as log
+replication (SendTX, raft-node.cc:340-365), majority acks advance ``blockNum``
+(raft-node.cc:234-251), stop at 50 blocks / 50 proposal rounds.  As SURVEY.md
+§2 notes, the reference has no terms, no log array, no commit index — it is
+Raft-flavored leader election + heartbeat replication, and this backend
+reproduces exactly that protocol.
+
+Reference call stack being tensorized (SURVEY.md §3.3):
+
+- election timer U[150,300) ms → ``sendVote`` (raft-node.cc:114,392-401):
+  self-vote latch ``has_voted=1``, VOTE_REQ broadcast, timer re-armed.
+- VOTE_REQ at a peer: grant iff ``has_voted==0`` (consuming the vote), unicast
+  VOTE_RES SUCCESS/FAILED back (raft-node.cc:154-167).
+- VOTE_RES at a candidate (raft-node.cc:196-232): per-arrival majority check
+  ``vote_success + 1 > N/2`` → become leader (cancel own timer, schedule
+  ``setProposal`` +1 s, send first heartbeat immediately); minority check
+  ``vote_failed >= N/2`` → reset counters and ``has_voted=0`` (retry on the
+  re-armed timer).
+- leader every 50 ms: plain HEARTBEAT, or 20 KB proposal block once
+  ``add_change_value`` is set (raft-node.cc:405-433); ``round==50`` clears
+  ``add_change_value`` (raft-node.cc:361-365); ``blockNum>=50`` cancels the
+  heartbeat (raft-node.cc:248-251).
+- follower: heartbeat cancels the election timer; proposal also stores
+  ``m_value``; always replies HEARTBEAT_RES SUCCESS (raft-node.cc:170-193).
+- leader counts proposal acks; exactly when ``vote_success+vote_failed==N-1``
+  it checks ``vote_success+1 > N/2`` → ``blockNum++`` (raft-node.cc:234-247).
+
+Tensorization: one tick = 1 ms for all N nodes.  Timers become per-node
+deadline registers compared against the tick counter (SURVEY.md §7).  Vote
+requests need receiver state at arrival (the ``has_voted`` latch), so they ride
+an identity-preserving matrix channel in ``edge`` mode, or a max-combined
+candidate-id channel in ``stat`` mode (ties between candidates arriving at the
+same receiver in the same tick resolve to one candidate — a documented
+large-N simplification).  Heartbeat acks never depend on follower state, so
+they are short-circuited round trips.  Echo-back (quirk #1) is not modeled.
+
+Fidelity modes:
+- ``reference``: a plain heartbeat cancels the election timer *permanently*
+  (the re-arm is commented out, raft-node.cc:177-178 — quirk #5), and a block
+  commits only when exactly all N-1 acks arrive (stalls under drops, as the
+  reference would).
+- ``clean``: heartbeats re-arm the election timer (real failure detection) and
+  a block commits as soon as acks reach the majority, latched once per round.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from blockchain_simulator_tpu.models.base import fault_masks, gated
+from blockchain_simulator_tpu.ops import delay as delay_ops
+from blockchain_simulator_tpu.ops import delivery as dv
+from blockchain_simulator_tpu.ops.ring import ring_pop, ring_push_add, ring_push_max
+from blockchain_simulator_tpu.utils.prng import Channel, chan_key
+
+# Timer sentinel: "canceled" (Simulator::Cancel).  Any tick comparison against
+# it is false for the whole simulation horizon.
+DISARM = jnp.int32(1 << 30)
+
+
+@struct.dataclass
+class RaftState:
+    is_leader: jax.Array      # [N] bool
+    has_voted: jax.Array      # [N] bool — single vote latch (no terms, quirk #6)
+    election_deadline: jax.Array  # [N] tick of next sendVote; DISARM = canceled
+    vote_success: jax.Array   # [N] election SUCCESS replies received
+    vote_failed: jax.Array    # [N] election FAILED replies received
+    next_hb: jax.Array        # [N] next heartbeat tick (leader); DISARM = off
+    proposal_tick: jax.Array  # [N] when setProposal fires; DISARM = unscheduled
+    add_change_value: jax.Array  # [N] bool — heartbeats carry proposals
+    m_value: jax.Array        # [N] last proposal value stored (-1 = unset)
+    block_num: jax.Array      # [N] blocks committed (leader counts)
+    round: jax.Array          # [N] proposal rounds broadcast (leader)
+    hb_succ: jax.Array        # [N] proposal-ack SUCCESS count, current round
+    hb_cnt: jax.Array         # [N] proposal-ack total count, current round
+    hb_open: jax.Array        # [N] bool — current round not yet committed
+    leader_tick: jax.Array    # [N] tick this node became leader (-1 = never)
+    elections: jax.Array      # [N] sendVote firings (metrics)
+    block_tick: jax.Array     # [N, B] commit tick per block at the leader (-1)
+    alive: jax.Array          # [N] bool fault mask
+    honest: jax.Array         # [N] bool fault mask
+
+
+@struct.dataclass
+class RaftBufs:
+    # vote requests: edge mode keeps sender identity [D, N_recv, N_glob];
+    # stat mode max-combines candidate id + 1 into [D, N_recv].
+    vreq: jax.Array
+    vres_ok: jax.Array   # [D, N] granted-vote arrivals at the candidate (add)
+    vres_no: jax.Array   # [D, N] denial arrivals at the candidate (add)
+    hb_plain: jax.Array  # [D, N] plain-heartbeat arrival counts (add)
+    hb_prop: jax.Array   # [D, N] proposal value + 1, max-combined (0 = empty)
+    hb_ok: jax.Array     # [D, N] proposal-ack SUCCESS arrivals at leader (add)
+    hb_bad: jax.Array    # [D, N] proposal-ack FAILED arrivals (Byzantine
+    # repliers flip to FAILED; disjoint peer set from hb_ok, so the two
+    # channels' independent delay draws cover disjoint edges)
+
+
+def init(cfg, key=None):
+    n, d = cfg.n, cfg.ring_depth
+    b = cfg.raft_max_blocks
+    alive, honest = fault_masks(cfg, n)
+    zi = lambda *sh: jnp.zeros(sh, jnp.int32)
+    zb = lambda *sh: jnp.zeros(sh, bool)
+    # initial election timeouts U[150,300) ms (raft-node.cc:69-72,114), drawn
+    # from the *init* key so the schedule is part of the state, not the tick
+    # stream
+    k = jax.random.key(cfg.seed) if key is None else key
+    deadline = jax.random.randint(
+        jax.random.fold_in(k, Channel.ELECTION),
+        (n,),
+        cfg.raft_election_lo_ms,
+        cfg.raft_election_hi_ms,
+        dtype=jnp.int32,
+    )
+    # crashed nodes never start an election
+    deadline = jnp.where(alive, deadline, DISARM)
+    state = RaftState(
+        is_leader=zb(n),
+        has_voted=zb(n),
+        election_deadline=deadline,
+        vote_success=zi(n),
+        vote_failed=zi(n),
+        next_hb=jnp.full((n,), DISARM),
+        proposal_tick=jnp.full((n,), DISARM),
+        add_change_value=zb(n),
+        m_value=jnp.full((n,), -1, jnp.int32),
+        block_num=zi(n),
+        round=zi(n),
+        hb_succ=zi(n),
+        hb_cnt=zi(n),
+        hb_open=zb(n),
+        leader_tick=jnp.full((n,), -1, jnp.int32),
+        elections=zi(n),
+        block_tick=jnp.full((n, b), -1, jnp.int32),
+        alive=alive,
+        honest=honest,
+    )
+    if cfg.delivery == "stat":
+        vreq = zi(d, n)
+    else:
+        vreq = zi(d, n, n)
+    bufs = RaftBufs(
+        vreq=vreq,
+        vres_ok=zi(d, n),
+        vres_no=zi(d, n),
+        hb_plain=zi(d, n),
+        hb_prop=zi(d, n),
+        hb_ok=zi(d, n),
+        hb_bad=zi(d, n),
+    )
+    return state, bufs
+
+
+
+
+def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
+    n = cfg.n
+    axis = cfg.mesh_axis
+    lo, hi = cfg.one_way_range()
+    rt_lo, rt_hi = cfg.roundtrip_range()
+    drop = cfg.faults.drop_prob
+    clean = cfg.fidelity == "clean"
+    stat = cfg.delivery == "stat"
+    ow_probs = delay_ops.uniform_probs(lo, hi)
+    rt_probs = delay_ops.roundtrip_probs(lo, hi)
+    n_loc = state.is_leader.shape[0]
+    ids = dv._global_ids(n_loc, axis)
+    zeros_flat = jnp.zeros((hi - lo, n_loc), jnp.int32)
+    zeros_rt = jnp.zeros((len(rt_probs), n_loc), jnp.int32)
+
+    # ---- pop arrivals; crashed nodes process nothing ------------------------
+    vreq_t, vreq = ring_pop(bufs.vreq, t)
+    ok_t, vres_ok = ring_pop(bufs.vres_ok, t)
+    no_t, vres_no = ring_pop(bufs.vres_no, t)
+    plain_t, hb_plain = ring_pop(bufs.hb_plain, t)
+    prop_t, hb_prop = ring_pop(bufs.hb_prop, t)
+    hbok_t, hb_ok = ring_pop(bufs.hb_ok, t)
+    hbbad_t, hb_bad = ring_pop(bufs.hb_bad, t)
+    am = state.alive.astype(jnp.int32)
+    ok_t, no_t = ok_t * am, no_t * am
+    plain_t, prop_t = plain_t * am, prop_t * am
+    hbok_t, hbbad_t = hbok_t * am, hbbad_t * am
+    hbtot_t = hbok_t + hbbad_t
+    if stat:
+        vreq_t = vreq_t * am
+    else:
+        vreq_t = vreq_t * am[:, None]
+
+    # ---- heartbeat arrivals (follower side, raft-node.cc:170-193) -----------
+    got_hb = (plain_t > 0) | (prop_t > 0)
+    m_value = jnp.where(prop_t > 0, prop_t - 1, state.m_value)
+    if clean:
+        # re-arm the election timer: real failure detection
+        k_e = chan_key(tkey, Channel.ELECTION)
+        if axis is not None:
+            k_e = jax.random.fold_in(k_e, jax.lax.axis_index(axis))
+        rearm = t + jax.random.randint(
+            k_e, (n_loc,), cfg.raft_election_lo_ms, cfg.raft_election_hi_ms,
+            dtype=jnp.int32,
+        )
+        election_deadline = jnp.where(got_hb, rearm, state.election_deadline)
+    else:
+        # quirk #5: Simulator::Cancel with the re-arm commented out
+        # (raft-node.cc:177-178) — one heartbeat pacifies a follower forever
+        election_deadline = jnp.where(got_hb, DISARM, state.election_deadline)
+
+    # ---- vote requests (acceptor side, raft-node.cc:154-167) ---------------
+    can_grant = ~state.has_voted & state.alive
+    if stat:
+        # vreq_t[i] = max candidate id + 1 seen this tick (the stat broadcast
+        # reaches the sender too — drop the self-request)
+        grant_to = vreq_t - 1  # global candidate id
+        has_req = (vreq_t > 0) & (grant_to != ids)
+        grant = has_req & can_grant
+        deny = has_req & ~can_grant
+        has_voted = state.has_voted | grant
+        # Byzantine receivers flip their replies (grant<->deny on the wire)
+        ok_wire = (grant & state.honest) | (deny & ~state.honest)
+        no_wire = (deny & state.honest) | (grant & ~state.honest)
+        # per-candidate reply counts (global scatter-add), multinomially spread
+        def reply_counts(wire):
+            c = jnp.zeros((n,), jnp.int32).at[grant_to].add(
+                wire.astype(jnp.int32), mode="drop"
+            )
+            if axis is not None:
+                c = jax.lax.psum(c, axis)
+                start = jax.lax.axis_index(axis) * n_loc
+                c = jax.lax.dynamic_slice_in_dim(c, start, n_loc)
+            return c
+
+        any_req = has_req.any()
+        k_vr = chan_key(tkey, Channel.DELAY_REPLY)
+
+        def reply_buckets():
+            mok = reply_counts(ok_wire)
+            mno = reply_counts(no_wire)
+            if drop > 0.0:
+                kd = jax.random.fold_in(k_vr, 0x0D17)
+                mok = jnp.round(jax.random.binomial(
+                    kd, mok.astype(jnp.float32), 1.0 - drop)).astype(jnp.int32)
+                mno = jnp.round(jax.random.binomial(
+                    jax.random.fold_in(kd, 1), mno.astype(jnp.float32),
+                    1.0 - drop)).astype(jnp.int32)
+            return jnp.stack([
+                delay_ops.sample_bucket_counts(
+                    jax.random.fold_in(k_vr, 7), mok, ow_probs),
+                delay_ops.sample_bucket_counts(
+                    jax.random.fold_in(k_vr, 8), mno, ow_probs),
+            ])
+
+        both = gated(
+            any_req, reply_buckets,
+            jnp.zeros((2, hi - lo, n_loc), jnp.int32), axis,
+        )
+        vres_ok = ring_push_add(vres_ok, t, lo, both[0])
+        vres_no = ring_push_add(vres_no, t, lo, both[1])
+    else:
+        # vreq_t[i, j] = 1 iff candidate j's request reaches i this tick.
+        # Concurrent same-tick requests: the vote goes to the lowest candidate
+        # id (the reference grants in serial arrival order; within one tick the
+        # order is undefined, so we fix a deterministic choice).
+        has_req = vreq_t > 0
+        any_req = has_req.any(axis=1)
+        first = jnp.argmax(has_req, axis=1)  # lowest j with a request
+        grant_mask = (
+            jax.nn.one_hot(first, vreq_t.shape[1], dtype=jnp.int32)
+            * (any_req & can_grant).astype(jnp.int32)[:, None]
+        )
+        deny_mask = has_req.astype(jnp.int32) - grant_mask
+        has_voted = state.has_voted | (any_req & can_grant)
+        hn = state.honest.astype(jnp.int32)[:, None]
+        ok_wire = grant_mask * hn + deny_mask * (1 - hn)
+        no_wire = deny_mask * hn + grant_mask * (1 - hn)
+        k_vr = chan_key(tkey, Channel.DELAY_REPLY)
+        both = gated(
+            any_req.any(),
+            lambda: jnp.stack([
+                dv.unicast_reply_counts_dense(
+                    jax.random.fold_in(k_vr, 7), ok_wire, lo, hi, drop, axis=axis),
+                dv.unicast_reply_counts_dense(
+                    jax.random.fold_in(k_vr, 8), no_wire, lo, hi, drop, axis=axis),
+            ]),
+            jnp.zeros((2, hi - lo, n_loc), jnp.int32),
+            axis,
+        )
+        vres_ok = ring_push_add(vres_ok, t, lo, both[0])
+        vres_no = ring_push_add(vres_no, t, lo, both[1])
+
+    # ---- vote responses (candidate side, raft-node.cc:196-232) --------------
+    vs = state.vote_success + ok_t * (~state.is_leader)
+    vf = state.vote_failed + no_t * (~state.is_leader)
+    win = ~state.is_leader & (ok_t > 0) & (vs + 1 > cfg.quorum) & state.alive
+    lose = ~win & (no_t > 0) & (vf >= cfg.quorum) & ~state.is_leader
+    vote_success = jnp.where(win | lose, 0, vs)
+    vote_failed = jnp.where(win | lose, 0, vf)
+    # winner: cancel own timer, first heartbeat NOW, proposals in +1 s
+    is_leader = state.is_leader | win
+    election_deadline = jnp.where(win, DISARM, election_deadline)
+    next_hb = jnp.where(win, jnp.int32(t), state.next_hb)
+    proposal_tick = jnp.where(
+        win, jnp.int32(t) + cfg.raft_proposal_delay_ms, state.proposal_tick
+    )
+    leader_tick = jnp.where(win & (state.leader_tick < 0), jnp.int32(t),
+                            state.leader_tick)
+    # loser: majority denied — release the vote latch and retry on the timer
+    has_voted = has_voted & ~lose
+
+    # ---- proposal acks (leader side, raft-node.cc:234-251) ------------------
+    hs = state.hb_succ + hbok_t
+    hc = state.hb_cnt + hbtot_t
+    if clean:
+        commit = state.hb_open & (hs + 1 > cfg.quorum) & is_leader
+        hb_open = state.hb_open & ~commit
+        hb_succ, hb_cnt = hs, hc
+    else:
+        # reference: the check runs only at exactly N-1 responses in
+        done = (hbtot_t > 0) & (hc == n - 1)
+        commit = done & (hs + 1 > cfg.quorum)
+        hb_succ = jnp.where(done, 0, hs)
+        hb_cnt = jnp.where(done, 0, hc)
+        hb_open = state.hb_open
+    blk = jnp.clip(state.block_num, 0, cfg.raft_max_blocks - 1)
+    block_tick = jnp.where(
+        (jax.nn.one_hot(blk, cfg.raft_max_blocks, dtype=bool)
+         & commit[:, None] & (state.block_num < cfg.raft_max_blocks)[:, None]),
+        jnp.int32(t),
+        state.block_tick,
+    )
+    block_num = state.block_num + commit
+    # blockNum >= 50 cancels the heartbeat (raft-node.cc:248-251)
+    next_hb = jnp.where(block_num >= cfg.raft_max_blocks, DISARM, next_hb)
+
+    # ---- timer: sendVote (raft-node.cc:392-401) -----------------------------
+    fire = (
+        (jnp.int32(t) >= election_deadline)
+        & (election_deadline != DISARM)
+        & ~is_leader
+        & state.alive
+    )
+    has_voted = has_voted | fire  # self-vote latch
+    k_e2 = chan_key(tkey, Channel.ELECTION + 100)
+    if axis is not None:
+        k_e2 = jax.random.fold_in(k_e2, jax.lax.axis_index(axis))
+    rearm2 = t + jax.random.randint(
+        k_e2, (n_loc,), cfg.raft_election_lo_ms, cfg.raft_election_hi_ms,
+        dtype=jnp.int32,
+    )
+    election_deadline = jnp.where(fire, rearm2, election_deadline)
+    elections = state.elections + fire
+    k_vq = chan_key(tkey, Channel.DELAY_BCAST)
+    if stat:
+        vq_contrib = gated(
+            fire.any(),
+            lambda: dv.bcast_value_max_stat(
+                k_vq, (ids + 1) * fire.astype(jnp.int32), ow_probs, drop,
+                axis=axis),
+            zeros_flat,
+            axis,
+        )
+        vreq = ring_push_max(vreq, t, lo, vq_contrib)
+    else:
+        vq_contrib = gated(
+            fire.any(),
+            lambda: dv.bcast_matrix_dense(
+                k_vq, fire, fire.astype(jnp.int32), lo, hi, drop, axis=axis),
+            jnp.zeros((hi - lo, n_loc, n), jnp.int32),
+            axis,
+        )
+        vreq = ring_push_max(vreq, t, lo, vq_contrib)
+
+    # ---- timer: sendHeartBeat (raft-node.cc:405-433) ------------------------
+    hb_fire = (
+        is_leader & (jnp.int32(t) >= next_hb) & (next_hb != DISARM) & state.alive
+    )
+    # setProposal fires exactly once (raft-node.cc:216,431-433) — round==50
+    # clears add_change_value for good, so the trigger must not re-fire
+    set_prop = (jnp.int32(t) >= proposal_tick) & (proposal_tick != DISARM)
+    add_change_value = state.add_change_value | set_prop
+    proposal_tick = jnp.where(set_prop, DISARM, proposal_tick)
+    prop_send = hb_fire & add_change_value
+    plain_send = hb_fire & ~add_change_value
+    next_hb = jnp.where(hb_fire, next_hb + cfg.raft_heartbeat_ms, next_hb)
+    # SendTX: round++; at round==50 stop adding proposals (raft-node.cc:361-365)
+    round_ = state.round + prop_send
+    add_change_value = add_change_value & ~(
+        prop_send & (round_ >= cfg.raft_max_rounds)
+    )
+    # new proposal round opens the ack window
+    hb_succ = jnp.where(prop_send, 0, hb_succ) if clean else hb_succ
+    hb_cnt = jnp.where(prop_send, 0, hb_cnt) if clean else hb_cnt
+    hb_open = (hb_open | prop_send) if clean else hb_open
+
+    ser = cfg.serialization_ticks(cfg.raft_block_bytes)
+    k_hb = chan_key(tkey, Channel.DELAY_BCAST2)
+    if stat:
+        plain_contrib = gated(
+            plain_send.any(),
+            lambda: dv.bcast_counts_stat(
+                k_hb,
+                _psum_scalar(plain_send.astype(jnp.int32).sum(), axis),
+                plain_send, ow_probs, drop, axis=axis),
+            zeros_flat,
+            axis,
+        )
+        prop_contrib = gated(
+            prop_send.any(),
+            lambda: dv.bcast_value_max_stat(
+                jax.random.fold_in(k_hb, 1),
+                (ids + 1) * prop_send.astype(jnp.int32), ow_probs, drop,
+                axis=axis),
+            zeros_flat,
+            axis,
+        )
+    else:
+        plain_contrib = gated(
+            plain_send.any(),
+            lambda: dv.bcast_counts_dense(k_hb, plain_send, lo, hi, drop,
+                                          axis=axis),
+            zeros_flat,
+            axis,
+        )
+        prop_contrib = gated(
+            prop_send.any(),
+            lambda: dv.bcast_value_max_dense(
+                jax.random.fold_in(k_hb, 1), prop_send,
+                (ids + 1) * prop_send.astype(jnp.int32), lo, hi, drop,
+                axis=axis),
+            zeros_flat,
+            axis,
+        )
+    hb_plain = ring_push_add(hb_plain, t, lo, plain_contrib)
+    hb_prop = ring_push_max(hb_prop, t, lo + ser, prop_contrib)
+
+    # proposal acks: follower state never affects the SUCCESS reply
+    # (raft-node.cc:170-193), so the round trip is short-circuited; Byzantine
+    # followers flip to FAILED.  The SUCCESS (honest) and FAILED (Byzantine)
+    # channels cover *disjoint* peer sets, so their independent delay draws
+    # cover disjoint edges — each ack lands in exactly one channel at one tick,
+    # and the leader's total count is their sum.
+    k_rt = chan_key(tkey, Channel.DELAY_ROUNDTRIP)
+    voters = state.alive & state.honest
+    liars = state.alive & ~state.honest
+    if stat:
+        n_voters = _psum_scalar(voters.astype(jnp.int32).sum(), axis)
+        n_liars = _psum_scalar(liars.astype(jnp.int32).sum(), axis)
+        ok_counts = gated(
+            prop_send.any(),
+            lambda: dv.roundtrip_reply_counts_stat(
+                k_rt, prop_send, n_voters - voters.astype(jnp.int32),
+                rt_probs, drop, axis=axis),
+            zeros_rt,
+            axis,
+        )
+        bad_counts = gated(
+            prop_send.any(),
+            lambda: dv.roundtrip_reply_counts_stat(
+                jax.random.fold_in(k_rt, 1), prop_send,
+                n_liars - liars.astype(jnp.int32), rt_probs, drop,
+                axis=axis),
+            zeros_rt,
+            axis,
+        )
+    else:
+        ok_counts = gated(
+            prop_send.any(),
+            lambda: dv.roundtrip_reply_counts_dense(
+                k_rt, prop_send, lo, hi, drop, peer_mask=voters, axis=axis),
+            zeros_rt,
+            axis,
+        )
+        bad_counts = gated(
+            prop_send.any(),
+            lambda: dv.roundtrip_reply_counts_dense(
+                jax.random.fold_in(k_rt, 1), prop_send, lo, hi, drop,
+                peer_mask=liars, axis=axis),
+            zeros_rt,
+            axis,
+        )
+    hb_ok = ring_push_add(hb_ok, t, rt_lo + ser, ok_counts)
+    hb_bad = ring_push_add(hb_bad, t, rt_lo + ser, bad_counts)
+
+    state = state.replace(
+        is_leader=is_leader,
+        has_voted=has_voted,
+        election_deadline=election_deadline,
+        vote_success=vote_success,
+        vote_failed=vote_failed,
+        next_hb=next_hb,
+        proposal_tick=proposal_tick,
+        add_change_value=add_change_value,
+        m_value=m_value,
+        block_num=block_num,
+        round=round_,
+        hb_succ=hb_succ,
+        hb_cnt=hb_cnt,
+        hb_open=hb_open,
+        leader_tick=leader_tick,
+        elections=elections,
+        block_tick=block_tick,
+    )
+    bufs = RaftBufs(
+        vreq=vreq, vres_ok=vres_ok, vres_no=vres_no, hb_plain=hb_plain,
+        hb_prop=hb_prop, hb_ok=hb_ok, hb_bad=hb_bad,
+    )
+    return state, bufs
+
+
+def _psum_scalar(x, axis):
+    return x if axis is None else jax.lax.psum(x, axis)
+
+
+def metrics(cfg, state: RaftState) -> dict:
+    """The reference's measurement surface (SURVEY.md §5): leader-elected time
+    (raft-node.cc:212), per-block processed time (:246), final Blocks/Rounds
+    summary (:122-123), election starts (:399)."""
+    alive = np.asarray(state.alive)
+    is_leader = np.asarray(state.is_leader)
+    leader_tick = np.asarray(state.leader_tick)
+    block_num = np.asarray(state.block_num)
+    block_tick = np.asarray(state.block_tick)
+    m_value = np.asarray(state.m_value)
+    leaders = np.flatnonzero(is_leader & alive)
+    # under Byzantine double-voting a split brain is possible (no terms);
+    # report the earliest-elected leader as "the" leader
+    lead = int(leaders[np.argmin(leader_tick[leaders])]) if leaders.size else -1
+    blocks = int(block_num[lead]) if lead >= 0 else 0
+    bt = block_tick[lead][: blocks] if lead >= 0 else np.array([])
+    # agreement: every alive follower that stored a value stored the leader's
+    stored = m_value[alive]
+    stored = stored[stored >= 0]
+    return {
+        "protocol": "raft",
+        "n": cfg.n,
+        "n_leaders": int(len(leaders)),
+        "leader": lead,
+        "leader_elected_ms": float(leader_tick[lead]) if lead >= 0 else -1.0,
+        "blocks": blocks,
+        "rounds": int(np.asarray(state.round).max()),
+        "elections": int(np.asarray(state.elections).sum()),
+        "last_block_ms": float(bt.max()) if bt.size else -1.0,
+        "mean_block_interval_ms": (
+            float(np.diff(bt).mean()) if bt.size > 1 else -1.0
+        ),
+        "agreement_ok": bool(
+            lead < 0 or (stored.size == 0) or (stored == lead).all()
+        ),
+    }

@@ -42,7 +42,7 @@ def make_sharded_sim_fn(cfg: SimConfig, mesh: Mesh):
     proto = get_protocol(cfg.protocol)
     cfg_local = cfg.with_(mesh_axis=NODES_AXIS)
 
-    state0, bufs0 = jax.eval_shape(lambda: proto.init(cfg))
+    state0, bufs0 = jax.eval_shape(lambda: proto.init(cfg, jax.random.key(0)))
     state_spec, bufs_spec = node_specs(state0, bufs0)
 
     def run(key, state, bufs):
@@ -66,7 +66,7 @@ def make_sharded_sim_fn(cfg: SimConfig, mesh: Mesh):
 
     @jax.jit
     def sim(key):
-        state, bufs = proto.init(cfg)
+        state, bufs = proto.init(cfg, jax.random.fold_in(key, 0x1217))
         return shmapped(key, state, bufs)
 
     return sim

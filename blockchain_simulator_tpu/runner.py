@@ -30,7 +30,7 @@ def make_sim_fn(cfg: SimConfig):
 
     @jax.jit
     def sim(key):
-        state, bufs = proto.init(cfg)
+        state, bufs = proto.init(cfg, jax.random.fold_in(key, 0x1217))
 
         def body(carry, t):
             st, bf = carry

@@ -164,18 +164,18 @@ class SimConfig:
 
     @property
     def ring_depth(self) -> int:
-        """Ring-buffer depth: must exceed the maximum scheduling horizon —
-        the round-trip tail or, with serialization modeled, a one-way
-        block-sized message (50 KB at 3 Mbps ≈ 134 ticks)."""
+        """Ring-buffer depth: must exceed the maximum scheduling horizon.
+        With serialization modeled, the worst case is a round trip whose
+        request leg carries a block-sized message (Raft proposal acks land at
+        rt_hi - 1 + ser; 20 KB at 3 Mbps ≈ 54 ticks)."""
         _, rt_hi = self.roundtrip_range()
-        _, hi = self.one_way_range()
         if self.protocol == "pbft":
             biggest = self.pbft_block_bytes
         elif self.protocol == "raft":
             biggest = self.raft_block_bytes
         else:
             biggest = 4
-        return max(rt_hi, hi + self.serialization_ticks(biggest)) + 1
+        return rt_hi + self.serialization_ticks(biggest) + 1
 
     @property
     def quorum(self) -> int:

@@ -43,6 +43,26 @@ def test_sharded_vs_unsharded_equivalence():
     assert abs(m_s["mean_time_to_finality_ms"] - m_u["mean_time_to_finality_ms"]) < 5
 
 
+def test_sharded_raft_both_delivery_modes():
+    mesh = make_mesh(n_node_shards=4)
+    cfg = SimConfig(protocol="raft", n=16, sim_ms=2500)
+    for dl in ("edge", "stat"):
+        m = run_sharded(cfg.with_(delivery=dl), mesh)
+        assert m["n_leaders"] == 1
+        assert m["blocks"] >= 20
+        assert m["agreement_ok"]
+
+
+def test_sharded_raft_matches_unsharded():
+    mesh = make_mesh(n_node_shards=4)
+    cfg = SimConfig(protocol="raft", n=16, sim_ms=2000)
+    m_s = run_sharded(cfg, mesh)
+    m_u = run_simulation(cfg)
+    assert m_s["n_leaders"] == m_u["n_leaders"] == 1
+    # shard-index key folding changes delay draws, not observable behavior
+    assert abs(m_s["blocks"] - m_u["blocks"]) <= 2
+
+
 def test_indivisible_shard_count_raises():
     mesh = make_mesh(n_node_shards=8)
     with pytest.raises(ValueError, match="not divisible"):

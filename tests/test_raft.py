@@ -1,0 +1,100 @@
+"""Raft integration tests against the reference milestones (SURVEY.md §4:
+single elected leader, 50 heartbeat-blocks at 50 ms cadence, stop conditions
+raft-node.cc:248-251,361-365)."""
+
+import numpy as np
+import pytest
+
+from blockchain_simulator_tpu import SimConfig, run_simulation
+from blockchain_simulator_tpu.runner import final_state
+from blockchain_simulator_tpu.utils.config import FaultConfig
+
+CFG = SimConfig(protocol="raft", n=8, sim_ms=5000)
+
+
+def test_raft_8_nodes_reference_milestones():
+    m = run_simulation(CFG)
+    # exactly one leader, elected within the first election window + spread
+    assert m["n_leaders"] == 1
+    assert 150 <= m["leader_elected_ms"] <= 400
+    # proposals start 1 s after election; 50 blocks at 50 ms cadence
+    assert m["blocks"] == 50
+    assert m["rounds"] == 50
+    assert m["agreement_ok"]
+    assert 49 <= m["mean_block_interval_ms"] <= 55
+
+
+def test_raft_reference_fidelity_milestones():
+    m = run_simulation(CFG.with_(fidelity="reference"))
+    assert m["n_leaders"] == 1
+    assert m["blocks"] == 50
+    # quirk #5: heartbeats cancel election timers permanently, so only the
+    # pre-election timer firings happen — a handful at most
+    assert m["elections"] <= 8
+
+
+def test_raft_stat_delivery_matches_milestones():
+    m = run_simulation(CFG.with_(delivery="stat"))
+    assert m["n_leaders"] == 1
+    assert m["blocks"] == 50
+    assert m["agreement_ok"]
+
+
+def test_raft_determinism():
+    assert run_simulation(CFG) == run_simulation(CFG)
+
+
+def test_raft_seed_sensitivity():
+    m1, m2 = run_simulation(CFG, seed=11), run_simulation(CFG, seed=22)
+    assert m1["blocks"] == m2["blocks"] == 50
+    # different seeds draw different election timeouts
+    assert (m1["leader"], m1["leader_elected_ms"]) != (
+        m2["leader"],
+        m2["leader_elected_ms"],
+    )
+
+
+def test_raft_follower_stores_leader_value():
+    st = final_state(CFG)
+    lead = int(np.flatnonzero(np.asarray(st.is_leader))[0])
+    m_value = np.asarray(st.m_value)
+    followers = [i for i in range(8) if i != lead]
+    assert (m_value[followers] == lead).all()
+
+
+def test_raft_block_ticks_are_heartbeat_cadence():
+    st = final_state(CFG)
+    lead = int(np.flatnonzero(np.asarray(st.is_leader))[0])
+    bt = np.asarray(st.block_tick)[lead]
+    bt = bt[bt >= 0]
+    assert len(bt) == 50
+    # consecutive commits are one heartbeat interval apart
+    assert (np.abs(np.diff(bt) - 50) <= 5).all()
+
+
+def test_raft_crash_minority_still_replicates():
+    m = run_simulation(CFG.with_(faults=FaultConfig(n_crashed=2)))
+    assert m["n_leaders"] == 1
+    assert m["blocks"] == 50
+    assert m["agreement_ok"]
+
+
+def test_raft_crash_majority_no_leader():
+    # 5 of 8 crashed: a candidate can reach at most 2 grants + itself = 3 <= 4
+    m = run_simulation(CFG.with_(faults=FaultConfig(n_crashed=5), sim_ms=2000))
+    assert m["n_leaders"] == 0
+    assert m["blocks"] == 0
+
+
+def test_raft_drops_tolerated_in_clean_mode():
+    m = run_simulation(CFG.with_(faults=FaultConfig(drop_prob=0.05)))
+    assert m["n_leaders"] >= 1
+    # majority-latched commits tolerate lossy links
+    assert m["blocks"] >= 45
+
+
+def test_raft_larger_cluster():
+    m = run_simulation(CFG.with_(n=64, sim_ms=4000))
+    assert m["n_leaders"] == 1
+    assert m["blocks"] == 50
+    assert m["agreement_ok"]

@@ -16,6 +16,7 @@ module-level triple of pure functions, selected at *runtime* by name:
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -40,9 +41,6 @@ def gated(pred, fn, zeros, axis=None):
     """Skip a delivery computation when no sender is active this tick.
     Sharded, the predicate must be globally agreed (the branch contains
     collectives), so it is pmax-reduced over the mesh axis first."""
-    import jax
-    import jax.numpy as jnp
-
     if axis is not None:
         pred = jax.lax.pmax(pred.astype(jnp.int32), axis) > 0
     return jax.lax.cond(pred, fn, lambda: zeros)

@@ -115,6 +115,11 @@ class SimConfig:
     paxos_n_proposers: int = 3  # nodes 0,1,2 propose at t=0 (paxos-node.cc:136)
     paxos_max_ticket: int = 120  # ticket values are single bytes in the
     # reference codec ('0'+t, paxos-node.cc:49-51); cap retries
+    paxos_retry_timeout_ms: int = 250  # clean-fidelity failure detection: a
+    # reply window unresolved after this long is abandoned and retried with a
+    # higher ticket (~2x the 106 ms max round trip).  The reference has no
+    # timeout — a lost reply wedges its proposer forever; reference fidelity
+    # reproduces that stall.
 
     # --- faults --------------------------------------------------------------
     faults: FaultConfig = dataclasses.field(default_factory=FaultConfig)

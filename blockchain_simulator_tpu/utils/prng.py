@@ -23,6 +23,7 @@ class Channel:
     DELAY_BCAST2 = 6     # second broadcast channel in the same tick
     DELAY_REPLY2 = 7
     STAT = 8             # statistical-delivery binomial chains
+    DELAY_BCAST3 = 9     # third broadcast channel (Paxos commit requests)
 
 
 def tick_key(base: jax.Array, tick) -> jax.Array:

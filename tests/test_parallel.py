@@ -63,6 +63,16 @@ def test_sharded_raft_matches_unsharded():
     assert abs(m_s["blocks"] - m_u["blocks"]) <= 2
 
 
+def test_sharded_paxos_matches_unsharded():
+    mesh = make_mesh(n_node_shards=4)
+    cfg = SimConfig(protocol="paxos", n=16, sim_ms=3000)
+    m_s = run_sharded(cfg, mesh)
+    m_u = run_simulation(cfg)
+    assert m_s["agreement_ok"] and m_u["agreement_ok"]
+    assert m_s["n_committed_proposers"] >= 1
+    assert m_u["n_committed_proposers"] >= 1
+
+
 def test_indivisible_shard_count_raises():
     mesh = make_mesh(n_node_shards=8)
     with pytest.raises(ValueError, match="not divisible"):

@@ -1,0 +1,7 @@
+"""``python -m blockchain_simulator_tpu`` — see cli.py."""
+
+import sys
+
+from blockchain_simulator_tpu.cli import main
+
+sys.exit(main())

@@ -1,0 +1,143 @@
+"""Command-line driver.
+
+The reference's ``main`` (blockchain-simulator.cc:63) instantiates
+``ns3::CommandLine`` but registers zero flags (SURVEY.md §5): N is hard-coded
+to 8, the protocol is chosen by *editing two source files*
+(network-helper.cc:17, blockchain-simulator.cc:72), and every operating
+constant is a literal.  Here every one of those constants is a runtime flag
+over the typed ``SimConfig`` (utils/config.py), the protocol is selected by
+name, and the execution engine is switchable between the JAX/TPU backend and
+the C++ CPU reference engine.
+
+    python -m blockchain_simulator_tpu --protocol pbft --n 8 --sim-ms 2500
+    python -m blockchain_simulator_tpu --protocol paxos --engine cpp --seeds 0 1 2
+    python -m blockchain_simulator_tpu --protocol raft --n 64 --shards 8
+
+Output: one JSON metrics line per run (the reference's NS_LOG measurement
+surface as structured data, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+from blockchain_simulator_tpu.utils.config import FaultConfig, SimConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    d = SimConfig()
+    p = argparse.ArgumentParser(
+        prog="blockchain_simulator_tpu",
+        description="TPU-native blockchain-consensus simulation framework",
+    )
+    p.add_argument("--protocol", choices=["pbft", "raft", "paxos"],
+                   default=d.protocol)
+    p.add_argument("--n", type=int, default=d.n, help="cluster size")
+    p.add_argument("--sim-ms", type=int, default=d.sim_ms,
+                   help="virtual-time window in ms")
+    p.add_argument("--seed", type=int, default=d.seed)
+    p.add_argument("--seeds", type=int, nargs="+", default=None,
+                   help="seed sweep (batched on the JAX engine)")
+    p.add_argument("--fidelity", choices=["reference", "clean"],
+                   default=d.fidelity)
+    p.add_argument("--delivery", choices=["edge", "stat"], default=d.delivery)
+    p.add_argument("--engine", choices=["jax", "cpp"], default="jax",
+                   help="jax = tensorized TPU backend; cpp = serial "
+                        "per-message C++ reference engine")
+    p.add_argument("--shards", type=int, default=0,
+                   help="shard node state over this many devices (jax engine)")
+    p.add_argument("--link-delay-ms", type=int, default=d.link_delay_ms)
+    # faults
+    p.add_argument("--crash", type=int, default=-1,
+                   help="number of crashed nodes")
+    p.add_argument("--byzantine", type=int, default=0,
+                   help="number of vote-flipping nodes")
+    p.add_argument("--drop", type=float, default=0.0,
+                   help="per-message drop probability")
+    # per-protocol knobs (reference values as defaults)
+    p.add_argument("--pbft-interval-ms", type=int, default=d.pbft_block_interval_ms)
+    p.add_argument("--pbft-rounds", type=int, default=d.pbft_max_rounds)
+    p.add_argument("--raft-heartbeat-ms", type=int, default=d.raft_heartbeat_ms)
+    p.add_argument("--raft-blocks", type=int, default=d.raft_max_blocks)
+    p.add_argument("--paxos-proposers", type=int, default=d.paxos_n_proposers)
+    p.add_argument("--timing", action="store_true",
+                   help="include wallclock timing in the output")
+    return p
+
+
+def config_from_args(args) -> SimConfig:
+    return SimConfig(
+        protocol=args.protocol,
+        n=args.n,
+        sim_ms=args.sim_ms,
+        seed=args.seed,
+        fidelity=args.fidelity,
+        delivery=args.delivery,
+        link_delay_ms=args.link_delay_ms,
+        pbft_block_interval_ms=args.pbft_interval_ms,
+        pbft_max_rounds=args.pbft_rounds,
+        raft_heartbeat_ms=args.raft_heartbeat_ms,
+        raft_max_blocks=args.raft_blocks,
+        paxos_n_proposers=args.paxos_proposers,
+        faults=FaultConfig(
+            n_crashed=args.crash, n_byzantine=args.byzantine, drop_prob=args.drop
+        ),
+    )
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args)
+    seeds = args.seeds if args.seeds is not None else [args.seed]
+
+    if args.engine == "cpp":
+        if args.shards > 1:
+            print("error: --shards requires the jax engine", file=sys.stderr)
+            return 2
+        import time
+
+        from blockchain_simulator_tpu.engine import run_cpp
+
+        for s in seeds:
+            t0 = time.perf_counter()
+            m = run_cpp(cfg, seed=s)
+            if args.timing:
+                m["wallclock_s"] = time.perf_counter() - t0
+            print(json.dumps(m))
+        return 0
+
+    if args.timing and (args.shards > 1 or len(seeds) > 1):
+        print("note: --timing applies to single-seed unsharded jax runs; "
+              "ignoring", file=sys.stderr)
+
+    if args.shards > 1:
+        from blockchain_simulator_tpu.parallel.mesh import make_mesh
+        from blockchain_simulator_tpu.parallel.shard import run_sharded
+        from blockchain_simulator_tpu.parallel.sweep import run_seed_sweep
+
+        mesh = make_mesh(n_node_shards=args.shards)
+        if len(seeds) > 1:
+            for m in run_seed_sweep(cfg, seeds=seeds, mesh=mesh):
+                print(json.dumps(m))
+        else:
+            print(json.dumps(run_sharded(cfg, mesh, seed=seeds[0])))
+        return 0
+
+    if len(seeds) > 1:
+        from blockchain_simulator_tpu.parallel.sweep import run_seed_sweep
+
+        for m in run_seed_sweep(cfg, seeds=seeds):
+            print(json.dumps(m))
+        return 0
+
+    from blockchain_simulator_tpu.runner import run_simulation
+
+    print(json.dumps(run_simulation(cfg, seed=seeds[0], with_timing=args.timing)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,128 @@
+"""C++ CPU reference engine bindings.
+
+The engine (``engine.cpp``) is the framework's native, serial, per-message
+discrete-event simulator — the self-contained replacement for the ns-3
+dependency the upstream reference schedules into (SURVEY.md §7 L6).  It is
+compiled on demand with ``g++ -O2 -shared -fPIC`` (cached next to the source,
+rebuilt when the source is newer) and called through ctypes with a flat config
+struct; results come back as a JSON metrics string with the same keys as the
+JAX backends' ``metrics()`` dicts, so differential tests compare them
+directly.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import pathlib
+import subprocess
+
+_DIR = pathlib.Path(__file__).resolve().parent
+_SRC = _DIR / "engine.cpp"
+_LIB = _DIR / "_libengine.so"
+
+_PROTOCOLS = {"pbft": 0, "raft": 1, "paxos": 2}
+
+
+class _CppCfg(ctypes.Structure):
+    # field order must match struct SimCfg in engine.cpp
+    _fields_ = [
+        ("protocol", ctypes.c_int32),
+        ("n", ctypes.c_int32),
+        ("sim_ms", ctypes.c_int32),
+        ("seed", ctypes.c_int64),
+        ("fidelity", ctypes.c_int32),
+        ("delay_lo", ctypes.c_int32),
+        ("delay_hi", ctypes.c_int32),
+        ("pbft_interval", ctypes.c_int32),
+        ("pbft_max_rounds", ctypes.c_int32),
+        ("pbft_slots", ctypes.c_int32),
+        ("pbft_vc_num", ctypes.c_int32),
+        ("pbft_vc_den", ctypes.c_int32),
+        ("raft_hb", ctypes.c_int32),
+        ("raft_elo", ctypes.c_int32),
+        ("raft_ehi", ctypes.c_int32),
+        ("raft_prop_delay", ctypes.c_int32),
+        ("raft_max_blocks", ctypes.c_int32),
+        ("raft_max_rounds", ctypes.c_int32),
+        ("paxos_p", ctypes.c_int32),
+        ("paxos_max_ticket", ctypes.c_int32),
+        ("paxos_timeout", ctypes.c_int32),
+        ("n_crashed", ctypes.c_int32),
+        ("n_byzantine", ctypes.c_int32),
+        ("drop_prob", ctypes.c_double),
+    ]
+
+
+def build(force: bool = False) -> pathlib.Path:
+    """Compile the engine if missing or stale; returns the .so path."""
+    if force or not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+        proc = subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+             "-o", str(_LIB), str(_SRC)],
+            capture_output=True,
+            text=True,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"engine compilation failed (g++ exit {proc.returncode}):\n"
+                f"{proc.stderr}"
+            )
+    return _LIB
+
+
+_lib_handle = None
+
+
+def _lib():
+    global _lib_handle
+    if _lib_handle is None:
+        handle = ctypes.CDLL(str(build()))
+        handle.run_sim.argtypes = [
+            ctypes.POINTER(_CppCfg), ctypes.c_char_p, ctypes.c_int
+        ]
+        handle.run_sim.restype = ctypes.c_int
+        _lib_handle = handle
+    return _lib_handle
+
+
+def cpp_config(cfg, seed: int | None = None) -> _CppCfg:
+    """Map a ``SimConfig`` onto the engine's flat config struct."""
+    lo, hi = cfg.one_way_range()
+    return _CppCfg(
+        protocol=_PROTOCOLS[cfg.protocol],
+        n=cfg.n,
+        sim_ms=cfg.sim_ms,
+        seed=cfg.seed if seed is None else seed,
+        fidelity=1 if cfg.fidelity == "clean" else 0,
+        delay_lo=lo,
+        delay_hi=hi,
+        pbft_interval=cfg.pbft_block_interval_ms,
+        pbft_max_rounds=cfg.pbft_max_rounds,
+        pbft_slots=cfg.pbft_max_slots,
+        pbft_vc_num=cfg.pbft_view_change_num,
+        pbft_vc_den=cfg.pbft_view_change_den,
+        raft_hb=cfg.raft_heartbeat_ms,
+        raft_elo=cfg.raft_election_lo_ms,
+        raft_ehi=cfg.raft_election_hi_ms,
+        raft_prop_delay=cfg.raft_proposal_delay_ms,
+        raft_max_blocks=cfg.raft_max_blocks,
+        raft_max_rounds=cfg.raft_max_rounds,
+        paxos_p=cfg.paxos_n_proposers,
+        paxos_max_ticket=cfg.paxos_max_ticket,
+        paxos_timeout=cfg.paxos_retry_timeout_ms,
+        n_crashed=cfg.faults.resolved_n_crashed(cfg.n),
+        n_byzantine=cfg.faults.n_byzantine,
+        drop_prob=cfg.faults.drop_prob,
+    )
+
+
+def run_cpp(cfg, seed: int | None = None) -> dict:
+    """Run one simulation on the C++ engine; returns the metrics dict
+    (same keys as the matching JAX backend's ``metrics()``)."""
+    c = cpp_config(cfg, seed)
+    buf = ctypes.create_string_buffer(4096)
+    rc = _lib().run_sim(ctypes.byref(c), buf, len(buf))
+    if rc != 0:
+        raise RuntimeError(f"engine run_sim failed with code {rc}")
+    return json.loads(buf.value.decode())

@@ -138,6 +138,10 @@ class SimConfig:
             raise ValueError(f"unknown fidelity {self.fidelity!r}")
         if self.topology not in ("full", "kregular"):
             raise ValueError(f"unknown topology {self.topology!r}")
+        if not 1 <= self.paxos_n_proposers <= self.n:
+            raise ValueError(
+                f"paxos_n_proposers={self.paxos_n_proposers} must be in [1, n={self.n}]"
+            )
 
     # --- derived quantities (plain python; all static under jit) ------------
     @property
@@ -159,6 +163,8 @@ class SimConfig:
         lo, hi = lo + d, hi + d
         if lo < 1:  # a message can never arrive in the tick it was sent
             lo, hi = 1, max(hi, 2)
+        if hi <= lo:  # degenerate range (e.g. delay_lo == delay_hi): one bucket
+            hi = lo + 1
         return lo, hi
 
     def roundtrip_range(self) -> tuple[int, int]:

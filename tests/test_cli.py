@@ -1,0 +1,62 @@
+"""CLI driver tests (the runtime replacement for the reference's
+compile-time protocol switch, SURVEY.md §1)."""
+
+import json
+
+import pytest
+
+from blockchain_simulator_tpu.cli import build_parser, config_from_args, main
+
+
+def run_cli(capsys, *argv):
+    assert main(list(argv)) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    return [json.loads(line) for line in out]
+
+
+def test_defaults_match_reference_constants():
+    args = build_parser().parse_args([])
+    cfg = config_from_args(args)
+    # the reference's hard-coded operating point (SURVEY.md §6)
+    assert cfg.protocol == "pbft" and cfg.n == 8 and cfg.sim_ms == 10_000
+    assert cfg.pbft_block_interval_ms == 50 and cfg.pbft_max_rounds == 40
+    assert cfg.raft_heartbeat_ms == 50 and cfg.raft_max_blocks == 50
+    assert cfg.paxos_n_proposers == 3
+
+
+def test_jax_engine_run(capsys):
+    (m,) = run_cli(capsys, "--protocol", "pbft", "--sim-ms", "800",
+                   "--pbft-rounds", "10")
+    assert m["protocol"] == "pbft"
+    assert m["blocks_final_all_nodes"] == 10
+
+
+def test_cpp_engine_run(capsys):
+    (m,) = run_cli(capsys, "--protocol", "raft", "--engine", "cpp",
+                   "--sim-ms", "6000")
+    assert m["protocol"] == "raft"
+    assert m["n_leaders"] == 1 and m["blocks"] == 50
+
+
+def test_seed_sweep_outputs_one_line_per_seed(capsys):
+    ms = run_cli(capsys, "--protocol", "paxos", "--engine", "cpp",
+                 "--seeds", "0", "1", "2", "--sim-ms", "4000")
+    assert len(ms) == 3
+    assert all(m["agreement_ok"] for m in ms)
+
+
+def test_fault_flags(capsys):
+    (m,) = run_cli(capsys, "--protocol", "pbft", "--engine", "cpp",
+                   "--crash", "4", "--sim-ms", "600")
+    assert m["blocks_final_all_nodes"] == 0
+
+
+def test_sharded_flag(capsys):
+    (m,) = run_cli(capsys, "--protocol", "pbft", "--n", "16", "--shards", "4",
+                   "--sim-ms", "400", "--pbft-rounds", "5")
+    assert m["blocks_final_all_nodes"] == 5
+
+
+def test_bad_protocol_rejected():
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["--protocol", "pow"])

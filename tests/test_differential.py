@@ -1,0 +1,127 @@
+"""Differential tests: C++ CPU reference engine vs the JAX/TPU backends
+(SURVEY.md §4 "property/differential").
+
+The two implementations are architecturally independent — the C++ engine is a
+serial per-message event-heap DES (the literal reference flow: every PREPARE
+delivered, every PREPARE_RES a separate unicast), while the JAX backends
+tensorize to slotted 1 ms ticks with count-consumed channels and
+short-circuited round trips.  They use different PRNGs, so traces cannot match
+event-for-event; what must match are the *consensus milestones* (rounds,
+blocks, finality counts, convergence) and *safety invariants* (agreement) for
+the same configuration, with timing metrics within the documented time-model
+mapping (both draw per-message delays from the same uniform distributions, so
+means match to within a few ms).
+"""
+
+import numpy as np
+import pytest
+
+from blockchain_simulator_tpu import SimConfig, run_simulation
+from blockchain_simulator_tpu.engine import run_cpp
+from blockchain_simulator_tpu.utils.config import FaultConfig
+
+
+def test_engine_builds():
+    from blockchain_simulator_tpu.engine import build
+
+    assert build().exists()
+
+
+@pytest.mark.parametrize("fidelity", ["clean", "reference"])
+def test_pbft_differential(fidelity):
+    cfg = SimConfig(protocol="pbft", n=8, sim_ms=2500, fidelity=fidelity)
+    mj = run_simulation(cfg)
+    mc = run_cpp(cfg)
+    # identical milestones: 40 rounds broadcast, all 40 reach finality
+    assert mc["rounds_sent"] == mj["rounds_sent"] == 40
+    assert mc["blocks_final_all_nodes"] == mj["blocks_final_all_nodes"] == 40
+    assert mc["agreement_ok"] and mj["agreement_ok"]
+    # same delay distribution → mean time-to-finality within a few ms
+    assert abs(mc["mean_time_to_finality_ms"] - mj["mean_time_to_finality_ms"]) < 6
+
+
+@pytest.mark.parametrize("fidelity", ["clean", "reference"])
+def test_raft_differential(fidelity):
+    cfg = SimConfig(protocol="raft", n=8, sim_ms=6000, fidelity=fidelity)
+    mj = run_simulation(cfg)
+    mc = run_cpp(cfg)
+    assert mc["n_leaders"] == mj["n_leaders"] == 1
+    assert mc["blocks"] == mj["blocks"] == 50
+    assert mc["agreement_ok"] and mj["agreement_ok"]
+    # election resolves within the first few timeout windows in both
+    assert mc["leader_elected_ms"] < 1000 and mj["leader_elected_ms"] < 1000
+    # leader replicates a block per 50 ms heartbeat in both
+    assert abs(mc["mean_block_interval_ms"] - mj["mean_block_interval_ms"]) < 5
+
+
+@pytest.mark.parametrize("fidelity", ["clean", "reference"])
+def test_paxos_differential(fidelity):
+    cfg = SimConfig(protocol="paxos", n=8, sim_ms=10_000, fidelity=fidelity)
+    mj = run_simulation(cfg)
+    mc = run_cpp(cfg)
+    # both converge: some proposer logs CLIENT COMMIT SUCCESS, one command
+    # decided, no safety violation
+    assert mc["n_committed_proposers"] >= 1 and mj["n_committed_proposers"] >= 1
+    assert mc["agreement_ok"] and mj["agreement_ok"]
+    assert mc["decided_command"] in (0, 1, 2)
+    assert mj["decided_command"] in (0, 1, 2)
+
+
+def test_pbft_crash_differential():
+    cfg = SimConfig(
+        protocol="pbft", n=8, sim_ms=1200, pbft_max_rounds=10,
+        faults=FaultConfig(n_crashed=1),
+    )
+    mj, mc = run_simulation(cfg), run_cpp(cfg)
+    assert mc["blocks_final_all_nodes"] == mj["blocks_final_all_nodes"] == 10
+    # crashed majority stalls identically
+    cfg = cfg.with_(faults=FaultConfig(n_crashed=4), sim_ms=600)
+    mj, mc = run_simulation(cfg), run_cpp(cfg)
+    assert mc["blocks_final_all_nodes"] == mj["blocks_final_all_nodes"] == 0
+
+
+def test_raft_crash_minority_differential():
+    cfg = SimConfig(
+        protocol="raft", n=8, sim_ms=6000, faults=FaultConfig(n_crashed=3)
+    )
+    mj, mc = run_simulation(cfg), run_cpp(cfg)
+    # a leader still emerges from the 5 alive nodes in both engines
+    assert mc["n_leaders"] >= 1 and mj["n_leaders"] >= 1
+    assert mc["blocks"] == mj["blocks"] == 50
+
+
+def test_paxos_crash_differential():
+    cfg = SimConfig(
+        protocol="paxos", n=8, sim_ms=8000, faults=FaultConfig(n_crashed=3)
+    )
+    mj, mc = run_simulation(cfg), run_cpp(cfg)
+    assert mc["n_committed_proposers"] >= 1 and mj["n_committed_proposers"] >= 1
+    assert mc["agreement_ok"] and mj["agreement_ok"]
+    # crashed majority stalls identically
+    cfg = cfg.with_(faults=FaultConfig(n_crashed=5), sim_ms=2000)
+    mj, mc = run_simulation(cfg), run_cpp(cfg)
+    assert mc["n_committed_proposers"] == mj["n_committed_proposers"] == 0
+
+
+def test_cpp_seed_determinism_and_sensitivity():
+    cfg = SimConfig(protocol="paxos", n=8, sim_ms=4000)
+    assert run_cpp(cfg, seed=7) == run_cpp(cfg, seed=7)
+    outs = {run_cpp(cfg, seed=s)["winner_commit_ms"] for s in range(5)}
+    assert len(outs) > 1
+
+
+def test_cpp_paxos_safety_sweep():
+    # the invariant the reference never checks, over many C++ seeds (cheap)
+    cfg = SimConfig(protocol="paxos", n=8, sim_ms=10_000)
+    for fid in ("clean", "reference"):
+        for s in range(20):
+            m = run_cpp(cfg.with_(fidelity=fid), seed=s)
+            assert m["agreement_ok"], (fid, s, m)
+
+
+def test_cpp_scales_to_thousands():
+    # the serial engine handles mid-scale N (the reference's ns-3 app cannot:
+    # O(N^2) link setup alone, SURVEY.md §5); beyond ~10k the JAX path owns it
+    m = run_cpp(SimConfig(protocol="pbft", n=500, sim_ms=300, pbft_max_rounds=4))
+    assert m["blocks_final_all_nodes"] == 4
+    assert m["agreement_ok"]

@@ -64,3 +64,78 @@ def final_state(cfg: SimConfig, seed: int | None = None):
     sim = make_sim_fn(cfg)
     key = jax.random.key(cfg.seed if seed is None else seed)
     return jax.block_until_ready(sim(key))
+
+
+@functools.lru_cache(maxsize=64)
+def make_segment_fn(cfg: SimConfig, n_ticks: int):
+    """Jitted ``seg(key, state, bufs, t0) -> (state, bufs)`` advancing the
+    simulation ``n_ticks`` ticks from traced start tick ``t0``.  Because tick
+    keys derive from the absolute tick (utils/prng.py), segmented execution is
+    bit-identical to one uninterrupted scan — the checkpoint/resume substrate
+    (the reference has none, SURVEY.md §5)."""
+    proto = get_protocol(cfg.protocol)
+
+    @jax.jit
+    def seg(key, state, bufs, t0):
+        def body(carry, t):
+            st, bf = carry
+            st, bf = proto.step(cfg, st, bf, t, prng.tick_key(key, t))
+            return (st, bf), ()
+
+        return jax.lax.scan(body, (state, bufs), t0 + jnp.arange(n_ticks))[0]
+
+    return seg
+
+
+def run_checkpointed(
+    cfg: SimConfig,
+    every_ms: int,
+    ckpt_dir,
+    seed: int | None = None,
+    keep_all: bool = False,
+):
+    """Run to completion, writing a checkpoint every ``every_ms`` virtual ms.
+
+    Returns ``(metrics, last_checkpoint_path)``.  ``keep_all`` retains every
+    snapshot (``ckpt_<tick>.npz``); otherwise only the latest survives.
+    """
+    import pathlib
+
+    from blockchain_simulator_tpu.utils.checkpoint import save_checkpoint
+
+    ckpt_dir = pathlib.Path(ckpt_dir)
+    ckpt_dir.mkdir(parents=True, exist_ok=True)
+    proto = get_protocol(cfg.protocol)
+    key = jax.random.key(cfg.seed if seed is None else seed)
+    state, bufs = proto.init(cfg, jax.random.fold_in(key, 0x1217))
+    t, last_path = 0, None
+    while t < cfg.ticks:
+        n = min(every_ms, cfg.ticks - t)
+        state, bufs = make_segment_fn(cfg, n)(key, state, bufs, jnp.int32(t))
+        t += n
+        jax.block_until_ready(state)
+        path = ckpt_dir / f"ckpt_{t:08d}.npz"
+        save_checkpoint(path, cfg, state, bufs, t)
+        if last_path is not None and not keep_all:
+            last_path.unlink()
+        last_path = path
+    return proto.metrics(cfg, state), last_path
+
+
+def resume_simulation(ckpt_path, seed: int | None = None):
+    """Load a checkpoint and run the remaining ticks; returns metrics.
+
+    ``seed`` must match the original run's (it defaults to the config's seed
+    stored in the checkpoint); the tick stream continues bit-exactly.
+    """
+    from blockchain_simulator_tpu.utils.checkpoint import load_checkpoint
+
+    cfg, state, bufs, t = load_checkpoint(ckpt_path)
+    proto = get_protocol(cfg.protocol)
+    key = jax.random.key(cfg.seed if seed is None else seed)
+    if t < cfg.ticks:
+        state, bufs = make_segment_fn(cfg, cfg.ticks - t)(
+            key, state, bufs, jnp.int32(t)
+        )
+        jax.block_until_ready(state)
+    return proto.metrics(cfg, state)

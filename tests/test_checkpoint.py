@@ -1,0 +1,100 @@
+"""Checkpoint/resume tests (SURVEY.md §5: the reference has no checkpointing;
+here the whole simulation is one serializable pytree and the threaded PRNG
+makes resumed runs bit-exact)."""
+
+import jax
+import numpy as np
+import pytest
+
+from blockchain_simulator_tpu import SimConfig, run_simulation
+from blockchain_simulator_tpu.runner import (
+    final_state,
+    make_segment_fn,
+    resume_simulation,
+    run_checkpointed,
+)
+from blockchain_simulator_tpu.utils.checkpoint import (
+    config_from_json,
+    config_to_json,
+    load_checkpoint,
+    save_checkpoint,
+)
+from blockchain_simulator_tpu.utils.config import FaultConfig
+
+
+CFG = SimConfig(protocol="pbft", n=8, sim_ms=1000, pbft_max_rounds=12)
+
+
+def test_config_json_roundtrip():
+    cfg = CFG.with_(faults=FaultConfig(n_crashed=1, drop_prob=0.1))
+    assert config_from_json(config_to_json(cfg)) == cfg
+
+
+def test_segmented_run_bit_exact():
+    # 4 segments == 1 uninterrupted scan, leaf for leaf
+    full = final_state(CFG)
+    from blockchain_simulator_tpu.models.base import get_protocol
+
+    proto = get_protocol(CFG.protocol)
+    key = jax.random.key(CFG.seed)
+    state, bufs = proto.init(CFG, jax.random.fold_in(key, 0x1217))
+    seg = make_segment_fn(CFG, 250)
+    for t0 in range(0, 1000, 250):
+        state, bufs = seg(key, state, bufs, jax.numpy.int32(t0))
+    for a, b in zip(jax.tree.leaves(full), jax.tree.leaves(state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_save_load_roundtrip(tmp_path):
+    from blockchain_simulator_tpu.models.base import get_protocol
+
+    proto = get_protocol(CFG.protocol)
+    state, bufs = proto.init(CFG, jax.random.key(0))
+    p = tmp_path / "ck.npz"
+    save_checkpoint(p, CFG, state, bufs, 123)
+    cfg2, s2, b2, t2 = load_checkpoint(p)
+    assert cfg2 == CFG and t2 == 123
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(s2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(bufs), jax.tree.leaves(b2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_resume_matches_uninterrupted(tmp_path):
+    m_full = run_simulation(CFG)
+    # run the first 400 ms with checkpoints, resume the rest from disk
+    cfg_half = CFG.with_(sim_ms=400)
+    from blockchain_simulator_tpu.models.base import get_protocol
+
+    proto = get_protocol(CFG.protocol)
+    key = jax.random.key(CFG.seed)
+    state, bufs = proto.init(CFG, jax.random.fold_in(key, 0x1217))
+    state, bufs = make_segment_fn(CFG, 400)(key, state, bufs, jax.numpy.int32(0))
+    p = tmp_path / "mid.npz"
+    save_checkpoint(p, CFG, state, bufs, 400)
+    m_resumed = resume_simulation(p)
+    assert m_resumed == m_full
+
+
+def test_run_checkpointed_end_to_end(tmp_path):
+    m, last = run_checkpointed(CFG, every_ms=300, ckpt_dir=tmp_path)
+    assert m == run_simulation(CFG)
+    assert last is not None and last.exists()
+    # only the latest snapshot kept by default
+    assert len(list(tmp_path.glob("ckpt_*.npz"))) == 1
+    # resume from the final checkpoint is a no-op returning the same metrics
+    assert resume_simulation(last) == m
+
+
+def test_run_checkpointed_keep_all(tmp_path):
+    run_checkpointed(CFG.with_(sim_ms=600), every_ms=200, ckpt_dir=tmp_path,
+                     keep_all=True)
+    assert len(list(tmp_path.glob("ckpt_*.npz"))) == 3
+
+
+def test_checkpoint_other_protocols(tmp_path):
+    for proto_name, ms in (("raft", 600), ("paxos", 600)):
+        cfg = SimConfig(protocol=proto_name, n=8, sim_ms=ms)
+        m_full = run_simulation(cfg)
+        m_seg, _ = run_checkpointed(cfg, every_ms=250, ckpt_dir=tmp_path / proto_name)
+        assert m_seg == m_full

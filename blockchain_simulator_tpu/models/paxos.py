@@ -60,6 +60,19 @@ same-tick requests is serialized in proposer order 0..P-1 (statically
 unrolled), a deterministic stand-in for the reference's arrival-order
 processing.  Retries cap at ``paxos_max_ticket`` (the reference's single-char
 codec would corrupt beyond '0'+9 anyway, quirk #11).
+
+Gossip topology (``topology="kregular"``, BASELINE config 3): requests are not
+broadcast — they *flood* over a random k-out digraph (ops/topology.py) with a
+hop TTL.  Channel values carry ``encoded * H + hops_left`` (H = gossip_hops+1,
+so a higher ticket always dominates in the max-combine regardless of TTL); a
+node that sees a new value (per-proposer monotone ``seen`` table — request
+encodings strictly increase per proposer, which is what makes value-dedup
+sound) processes it as an acceptor, replies *directly* to the proposer
+(response overlay — replies are point-to-point in the protocol; gossip is for
+dissemination), and forwards it to its out-neighbors with fresh per-edge
+delays.  Per-tick cost is O(N·deg·P).  Clean-fidelity window timeouts must
+cover the full flood + reply horizon ``(gossip_hops+1) * delay_hi`` (validated
+in ``init``) so the temporal-separation argument still holds.
 """
 
 from __future__ import annotations
@@ -72,6 +85,7 @@ from flax import struct
 from blockchain_simulator_tpu.models.base import fault_masks, gated
 from blockchain_simulator_tpu.ops import delay as delay_ops
 from blockchain_simulator_tpu.ops import delivery as dv
+from blockchain_simulator_tpu.ops import topology
 from blockchain_simulator_tpu.ops.ring import ring_pop, ring_push_add, ring_push_max
 from blockchain_simulator_tpu.utils.prng import Channel, chan_key
 
@@ -98,6 +112,8 @@ class PaxosState:
     commit_tick: jax.Array   # [N] CLIENT COMMIT SUCCESS tick (-1 = never)
     gave_up: jax.Array       # [N] bool — retry budget exhausted
     window_deadline: jax.Array  # [N] clean-fidelity retry timeout tick
+    seen_req: jax.Array      # [N, 3, P] gossip dedup: highest request
+    # encoding seen per (channel, proposer); zeros and unused on full mesh
     alive: jax.Array
     honest: jax.Array
 
@@ -123,10 +139,16 @@ def init(cfg, key=None):
     n, d, p = cfg.n, cfg.ring_depth, cfg.paxos_n_proposers
     if cfg.fidelity == "clean":
         _, rt_hi = cfg.roundtrip_range()
-        if cfg.paxos_retry_timeout_ms < rt_hi:
+        horizon = rt_hi
+        if cfg.topology == "kregular":
+            # an origin send with TTL=gossip_hops can traverse gossip_hops+1
+            # flood legs (arrival TTLs gossip_hops..0 all processed + replied)
+            # plus the direct reply leg, each up to hi-1 ms
+            horizon = (cfg.gossip_hops + 2) * cfg.one_way_range()[1]
+        if cfg.paxos_retry_timeout_ms < horizon:
             raise ValueError(
                 f"paxos_retry_timeout_ms={cfg.paxos_retry_timeout_ms} must be "
-                f">= the max reply round trip ({rt_hi} ms): clean-fidelity "
+                f">= the max reply horizon ({horizon} ms): clean-fidelity "
                 "correctness relies on abandoned windows draining before retry"
             )
     alive, honest = fault_masks(cfg, n)
@@ -148,6 +170,7 @@ def init(cfg, key=None):
         commit_tick=jnp.full((n,), -1, jnp.int32),
         gave_up=zb(n),
         window_deadline=jnp.full((n,), 1 << 30, jnp.int32),
+        seen_req=zi(n, 3, p),
         alive=alive,
         honest=honest,
     )
@@ -185,6 +208,35 @@ def _req_contrib(key, val_local, lo, hi, drop, axis, ids, p, ref_skip):
     return jnp.stack(
         [(d == lo + b).astype(jnp.int32) * m * val_g[None, :] for b in range(hi - lo)]
     )
+
+
+def _gossip_fwd_contrib(key, fwd_vals, nbrs_loc, n_glob, lo, hi, drop, axis):
+    """Gossip forwarding: TTL-encoded values held by local rows → [B, N_loc, P]
+    scatter-max contributions at their out-neighbors (global ids), one fresh
+    delay draw per (sender, edge, proposer).  Sharded: scatter into the global
+    row space, pmax across shards (each shard contributes its senders'
+    forwards), slice the local rows back out."""
+    n_loc, p = fwd_vals.shape
+    deg = nbrs_loc.shape[1]
+    k = dv._shard_key(key, axis)
+    d = delay_ops.sample_edge_delays(k, (n_loc, deg, p), lo, hi)
+    vals = jnp.broadcast_to(fwd_vals[:, None, :], (n_loc, deg, p))
+    if drop > 0.0:
+        keep = jax.random.bernoulli(
+            jax.random.fold_in(k, 0x0D22), 1.0 - drop, (n_loc, deg, p)
+        )
+        vals = vals * keep
+    # one scatter-max over a flattened (bucket, receiver) index — XLA handles
+    # a single big scatter far better than hi-lo separate ones
+    flat_idx = (d - lo) * n_glob + nbrs_loc[:, :, None]  # [n_loc, deg, p]
+    flat = jnp.zeros(((hi - lo) * n_glob, p), jnp.int32)
+    flat = flat.at[flat_idx, jnp.arange(p)[None, None, :]].max(vals)
+    out = flat.reshape(hi - lo, n_glob, p)
+    if axis is not None:
+        out = jax.lax.pmax(out, axis)
+        start = jax.lax.axis_index(axis) * n_loc
+        out = jax.lax.dynamic_slice_in_dim(out, start, n_loc, axis=1)
+    return out
 
 
 def _reply_contribs(key, ok_wire, no_wire, cmd_wire, lo, hi, drop, axis, ids, p):
@@ -248,6 +300,27 @@ def step(cfg, state: PaxosState, bufs: PaxosBufs, t, tkey):
     rt_t, rp_t, rc_t = rt_t * am[:, None], rp_t * am[:, None], rc_t * am[:, None]
     ok_t, no_t = ok_t * am[:, None], no_t * am[:, None]
     cmd_t = cmd_t * am
+
+    # ---- gossip decode: TTL values → new-request dedup + forward set --------
+    gossip = cfg.topology == "kregular"
+    seen_req = state.seen_req
+    fwd_vals = None
+    if gossip:
+        h_enc = cfg.gossip_hops + 1
+        nbrs_loc = jnp.take(
+            jnp.asarray(topology.kregular_out_neighbors(n, cfg.degree, cfg.seed)),
+            ids, axis=0,
+        )
+        fwd_vals, proc = [], []
+        for ci, arr in enumerate((rt_t, rp_t, rc_t)):
+            base, hops = arr // h_enc, arr % h_enc
+            new = (base > seen_req[:, ci, :]) & state.alive[:, None]
+            proc.append(base * new)
+            seen_req = seen_req.at[:, ci, :].max(base * new)
+            fwd_vals.append(
+                (base * h_enc + jnp.maximum(hops - 1, 0)) * (new & (hops > 0))
+            )
+        rt_t, rp_t, rc_t = proc  # acceptors process first sightings only
 
     # ---- acceptor FSM: concurrent requests serialized in proposer order -----
     t_max, command, t_store = state.t_max, state.command, state.t_store
@@ -438,25 +511,44 @@ def step(cfg, state: PaxosState, bufs: PaxosBufs, t, tkey):
     pp_val = (state.ticket * c_enc + proposal + 1) * adv0.astype(jnp.int32)
     cm_val = (state.ticket * c_enc + state.proposal + 1) * adv1.astype(jnp.int32)
     zeros_req = jnp.zeros((nb, n_loc, p), jnp.int32)
-    for buf_name, val, chan in (
-        ("req_ticket", tk_val, Channel.DELAY_BCAST),
-        ("req_propose", pp_val, Channel.DELAY_BCAST2),
-        ("req_commit", cm_val, Channel.DELAY_BCAST3),
-    ):
-        contrib = gated(
-            (val > 0).any(),
-            lambda v=val, c=chan: _req_contrib(
-                chan_key(tkey, c), v, lo, hi, drop, axis, ids, p, ref_skip
-            ),
-            zeros_req,
-            axis,
-        )
-        if buf_name == "req_ticket":
-            req_ticket = ring_push_max(req_ticket, t, lo, contrib)
-        elif buf_name == "req_propose":
-            req_propose = ring_push_max(req_propose, t, lo, contrib)
-        else:
-            req_commit = ring_push_max(req_commit, t, lo, contrib)
+    channels = (
+        (tk_val, Channel.DELAY_BCAST),
+        (pp_val, Channel.DELAY_BCAST2),
+        (cm_val, Channel.DELAY_BCAST3),
+    )
+    contribs = []
+    if gossip:
+        # a proposer's own send is the flood origin: full TTL, own column,
+        # marked seen so the loopback copy is not re-forwarded
+        own = (ids[:, None] == jnp.arange(p)[None, :]).astype(jnp.int32)
+        for ci, (val, chan) in enumerate(channels):
+            init_mat = val[:, None] * own
+            seen_req = seen_req.at[:, ci, :].max(init_mat)
+            enc = jnp.maximum(
+                fwd_vals[ci],
+                (init_mat * h_enc + cfg.gossip_hops) * (init_mat > 0),
+            )
+            contribs.append(gated(
+                (enc > 0).any(),
+                lambda e=enc, c=chan: _gossip_fwd_contrib(
+                    chan_key(tkey, c), e, nbrs_loc, n, lo, hi, drop, axis
+                ),
+                zeros_req,
+                axis,
+            ))
+    else:
+        for val, chan in channels:
+            contribs.append(gated(
+                (val > 0).any(),
+                lambda v=val, c=chan: _req_contrib(
+                    chan_key(tkey, c), v, lo, hi, drop, axis, ids, p, ref_skip
+                ),
+                zeros_req,
+                axis,
+            ))
+    req_ticket = ring_push_max(req_ticket, t, lo, contribs[0])
+    req_propose = ring_push_max(req_propose, t, lo, contribs[1])
+    req_commit = ring_push_max(req_commit, t, lo, contribs[2])
 
     state = state.replace(
         t_max=t_max,
@@ -473,6 +565,7 @@ def step(cfg, state: PaxosState, bufs: PaxosBufs, t, tkey):
         commit_tick=commit_tick,
         gave_up=gave_up,
         window_deadline=window_deadline,
+        seen_req=seen_req,
     )
     bufs = PaxosBufs(
         req_ticket=req_ticket,

@@ -1,0 +1,53 @@
+"""Topology builders.
+
+The reference builds exactly one topology: a full mesh of N·(N-1)/2
+point-to-point links (blockchain-simulator.cc:34-51), O(N²) in links and
+per-wave messages — the scaling wall (SURVEY.md §5 "long-context" analog).
+The framework's delivery ops treat the full mesh implicitly (broadcast = all
+peers); this module adds the sparse alternative for 10k+ nodes (BASELINE
+config 3): a random k-out gossip digraph over which requests *flood* with a
+hop TTL instead of being broadcast edge-by-edge.
+
+``kregular_out_neighbors`` returns a ``[N, deg]`` table of global receiver
+ids: column 0 is the successor ring edge (guarantees strong connectivity),
+the remaining columns are independent random permutations (one out-edge per
+node each, giving the O(log N) diameter of a random regular digraph).
+Self-loops and duplicate edges can occur in the random columns and are
+harmless — gossip delivery deduplicates by value at the receiver.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def kregular_out_neighbors(n: int, deg: int, seed: int) -> np.ndarray:
+    """[N, deg] int32 global out-neighbor table (ring + deg-1 random
+    permutation columns), deterministic in ``seed``."""
+    if deg < 2:
+        raise ValueError(f"gossip degree must be >= 2, got {deg}")
+    rng = np.random.default_rng(seed ^ 0x70B0)
+    cols = [(np.arange(n) + 1) % n]
+    for _ in range(deg - 1):
+        cols.append(rng.permutation(n))
+    return np.stack(cols, axis=1).astype(np.int32)
+
+
+def flood_reach_hops(n: int, deg: int, nbrs: np.ndarray, src: int) -> int:
+    """BFS hop count to reach every node from ``src`` (test/validation aid)."""
+    dist = np.full(n, -1)
+    dist[src] = 0
+    frontier = [src]
+    hops = 0
+    while frontier:
+        hops += 1
+        nxt = []
+        for u in frontier:
+            for v in nbrs[u]:
+                if dist[v] < 0:
+                    dist[v] = hops
+                    nxt.append(v)
+        frontier = nxt
+    if (dist < 0).any():
+        raise ValueError("gossip graph not strongly connected from src")
+    return int(dist.max())

@@ -103,10 +103,16 @@ def run_checkpointed(
 
     from blockchain_simulator_tpu.utils.checkpoint import save_checkpoint
 
+    if every_ms < 1:
+        raise ValueError(f"every_ms must be >= 1, got {every_ms}")
     ckpt_dir = pathlib.Path(ckpt_dir)
     ckpt_dir.mkdir(parents=True, exist_ok=True)
+    # bake the effective seed into the stored config so resume_simulation
+    # continues the exact PRNG stream without needing the override repeated
+    if seed is not None:
+        cfg = cfg.with_(seed=seed)
     proto = get_protocol(cfg.protocol)
-    key = jax.random.key(cfg.seed if seed is None else seed)
+    key = jax.random.key(cfg.seed)
     state, bufs = proto.init(cfg, jax.random.fold_in(key, 0x1217))
     t, last_path = 0, None
     while t < cfg.ticks:

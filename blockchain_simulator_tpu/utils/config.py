@@ -68,8 +68,10 @@ class SimConfig:
 
     # --- topology -----------------------------------------------------------
     topology: str = "full"  # "full" (reference, blockchain-simulator.cc:34-51)
-    # or "kregular" (random k-regular gossip graph for 10k+ nodes)
-    degree: int = 16  # gossip degree when topology == "kregular"
+    # or "kregular" (random k-out gossip digraph for 10k+ nodes, BASELINE
+    # config 3: requests flood with a hop TTL instead of O(N) broadcasts)
+    degree: int = 16  # gossip out-degree when topology == "kregular"
+    gossip_hops: int = 8  # flood TTL; must cover the graph diameter (~log_deg N)
 
     # --- execution backend --------------------------------------------------
     # "edge": exact per-edge delay sampling (O(N^2) work per active tick).
@@ -142,6 +144,17 @@ class SimConfig:
             raise ValueError(
                 f"paxos_n_proposers={self.paxos_n_proposers} must be in [1, n={self.n}]"
             )
+        if self.topology == "kregular":
+            if self.protocol != "paxos":
+                raise NotImplementedError(
+                    "gossip topology is currently implemented for paxos "
+                    "(BASELINE config 3); pbft/raft use full mesh"
+                )
+            if self.fidelity != "clean":
+                raise ValueError(
+                    "reference fidelity is defined on the full mesh only "
+                    "(the reference has no gossip relay)"
+                )
 
     # --- derived quantities (plain python; all static under jit) ------------
     @property

@@ -62,8 +62,7 @@ def test_checkpoint_save_load_roundtrip(tmp_path):
 
 def test_resume_matches_uninterrupted(tmp_path):
     m_full = run_simulation(CFG)
-    # run the first 400 ms with checkpoints, resume the rest from disk
-    cfg_half = CFG.with_(sim_ms=400)
+    # run the first 400 ms, checkpoint, resume the rest from disk
     from blockchain_simulator_tpu.models.base import get_protocol
 
     proto = get_protocol(CFG.protocol)
@@ -90,6 +89,20 @@ def test_run_checkpointed_keep_all(tmp_path):
     run_checkpointed(CFG.with_(sim_ms=600), every_ms=200, ckpt_dir=tmp_path,
                      keep_all=True)
     assert len(list(tmp_path.glob("ckpt_*.npz"))) == 3
+
+
+def test_run_checkpointed_seed_override_resumes_correctly(tmp_path):
+    # the effective seed is baked into the stored config, so a resumed run
+    # continues seed 5's stream, not cfg.seed's
+    m5 = run_simulation(CFG, seed=5)
+    m, last = run_checkpointed(CFG, every_ms=400, ckpt_dir=tmp_path, seed=5)
+    assert m == m5
+    assert resume_simulation(last) == m5
+
+
+def test_run_checkpointed_rejects_bad_interval(tmp_path):
+    with pytest.raises(ValueError, match="every_ms"):
+        run_checkpointed(CFG, every_ms=0, ckpt_dir=tmp_path)
 
 
 def test_checkpoint_other_protocols(tmp_path):

@@ -1,0 +1,84 @@
+"""Gossip-topology tests (BASELINE config 3: Paxos over a random k-out
+digraph with TTL'd flooding instead of O(N) broadcasts)."""
+
+import numpy as np
+import pytest
+
+from blockchain_simulator_tpu import SimConfig, run_simulation
+from blockchain_simulator_tpu.ops.topology import (
+    flood_reach_hops,
+    kregular_out_neighbors,
+)
+from blockchain_simulator_tpu.utils.config import FaultConfig
+
+
+GCFG = SimConfig(
+    protocol="paxos", n=256, sim_ms=6000, topology="kregular",
+    degree=8, gossip_hops=8, paxos_retry_timeout_ms=600,
+)
+
+
+def test_graph_shape_and_determinism():
+    a = kregular_out_neighbors(128, 6, seed=3)
+    b = kregular_out_neighbors(128, 6, seed=3)
+    assert a.shape == (128, 6)
+    np.testing.assert_array_equal(a, b)
+    assert (kregular_out_neighbors(128, 6, seed=4) != a).any()
+
+
+def test_graph_diameter_covers_hop_budget():
+    nbrs = kregular_out_neighbors(GCFG.n, GCFG.degree, GCFG.seed)
+    for src in (0, 1, 2):
+        assert flood_reach_hops(GCFG.n, GCFG.degree, nbrs, src) <= GCFG.gossip_hops
+
+
+def test_gossip_paxos_converges():
+    m = run_simulation(GCFG)
+    assert m["n_committed_proposers"] >= 1
+    assert m["agreement_ok"]
+    # the flood reached every acceptor: all 256 executed the decided command
+    assert m["acceptor_executes"] == GCFG.n
+
+
+def test_gossip_determinism():
+    assert run_simulation(GCFG) == run_simulation(GCFG)
+
+
+def test_gossip_with_crashed_relays():
+    # crashed nodes neither process nor forward; random chords route around
+    cfg = GCFG.with_(faults=FaultConfig(n_crashed=32), sim_ms=8000)
+    m = run_simulation(cfg)
+    assert m["n_committed_proposers"] >= 1
+    assert m["agreement_ok"]
+    # a true majority of all N acceptors still executes
+    assert m["acceptor_executes"] >= GCFG.n // 2 + 1
+
+
+def test_gossip_sharded():
+    import jax
+
+    from blockchain_simulator_tpu.parallel.mesh import make_mesh
+    from blockchain_simulator_tpu.parallel.shard import run_sharded
+
+    mesh = make_mesh(n_node_shards=4)
+    m = run_sharded(GCFG.with_(n=128), mesh)
+    assert m["n_committed_proposers"] >= 1
+    assert m["agreement_ok"]
+    assert m["acceptor_executes"] == 128
+
+
+def test_gossip_validation():
+    # timeout below the flood horizon
+    with pytest.raises(ValueError, match="reply horizon"):
+        from blockchain_simulator_tpu.models import paxos
+
+        paxos.init(GCFG.with_(paxos_retry_timeout_ms=200))
+    # gossip is paxos-only for now
+    with pytest.raises(NotImplementedError):
+        SimConfig(protocol="pbft", topology="kregular")
+    # reference fidelity has no gossip relay
+    with pytest.raises(ValueError, match="full mesh"):
+        SimConfig(protocol="paxos", topology="kregular", fidelity="reference")
+    # degenerate degree
+    with pytest.raises(ValueError, match="degree"):
+        kregular_out_neighbors(64, 1, seed=0)

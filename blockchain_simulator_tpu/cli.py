@@ -33,7 +33,7 @@ def build_parser() -> argparse.ArgumentParser:
         prog="blockchain_simulator_tpu",
         description="TPU-native blockchain-consensus simulation framework",
     )
-    p.add_argument("--protocol", choices=["pbft", "raft", "paxos"],
+    p.add_argument("--protocol", choices=["pbft", "raft", "paxos", "mixed"],
                    default=d.protocol)
     p.add_argument("--n", type=int, default=d.n, help="cluster size")
     p.add_argument("--sim-ms", type=int, default=d.sim_ms,
@@ -50,6 +50,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--shards", type=int, default=0,
                    help="shard node state over this many devices (jax engine)")
     p.add_argument("--link-delay-ms", type=int, default=d.link_delay_ms)
+    # topology (BASELINE config 3: gossip instead of full mesh)
+    p.add_argument("--topology", choices=["full", "kregular"], default=d.topology)
+    p.add_argument("--degree", type=int, default=d.degree,
+                   help="gossip out-degree (kregular)")
+    p.add_argument("--gossip-hops", type=int, default=d.gossip_hops,
+                   help="flood TTL (kregular)")
+    p.add_argument("--paxos-timeout-ms", type=int, default=d.paxos_retry_timeout_ms,
+                   help="clean-fidelity retry window timeout")
     # faults
     p.add_argument("--crash", type=int, default=-1,
                    help="number of crashed nodes")
@@ -63,6 +71,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--raft-heartbeat-ms", type=int, default=d.raft_heartbeat_ms)
     p.add_argument("--raft-blocks", type=int, default=d.raft_max_blocks)
     p.add_argument("--paxos-proposers", type=int, default=d.paxos_n_proposers)
+    p.add_argument("--mixed-shards", type=int, default=d.mixed_shards,
+                   help="raft shard count for --protocol mixed")
     p.add_argument("--timing", action="store_true",
                    help="include wallclock timing in the output")
     return p
@@ -77,11 +87,16 @@ def config_from_args(args) -> SimConfig:
         fidelity=args.fidelity,
         delivery=args.delivery,
         link_delay_ms=args.link_delay_ms,
+        topology=args.topology,
+        degree=args.degree,
+        gossip_hops=args.gossip_hops,
+        paxos_retry_timeout_ms=args.paxos_timeout_ms,
         pbft_block_interval_ms=args.pbft_interval_ms,
         pbft_max_rounds=args.pbft_rounds,
         raft_heartbeat_ms=args.raft_heartbeat_ms,
         raft_max_blocks=args.raft_blocks,
         paxos_n_proposers=args.paxos_proposers,
+        mixed_shards=args.mixed_shards,
         faults=FaultConfig(
             n_crashed=args.crash, n_byzantine=args.byzantine, drop_prob=args.drop
         ),

@@ -30,6 +30,8 @@ def get_protocol(name: str):
             from blockchain_simulator_tpu.models import raft as m
         elif name == "paxos":
             from blockchain_simulator_tpu.models import paxos as m
+        elif name == "mixed":
+            from blockchain_simulator_tpu.models import mixed as m
         else:
             raise ValueError(f"unknown protocol {name!r}")
     except ImportError as e:

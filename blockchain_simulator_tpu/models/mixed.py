@@ -1,0 +1,126 @@
+"""Mixed-protocol shard simulation (BASELINE config 5).
+
+``S`` Raft shards of ``m`` nodes each (``n = S·m``) run leader election +
+heartbeat replication *internally*, while a cross-shard PBFT instance over the
+``S`` shard representatives finalizes global blocks.  This is the hierarchical
+composition named in BASELINE.json ("256 Raft shards × 1k nodes with
+cross-shard PBFT finality") — a capability with no reference counterpart (the
+reference runs exactly one protocol per compiled binary, SURVEY.md §1).
+
+Composition is pure function reuse, the payoff of the protocol-backend API
+(models/base.py): the Raft backend's ``step`` is ``jax.vmap``-ed over the
+shard axis (every leaf ``[m, ...]`` → ``[S, m, ...]``, per-shard PRNG streams
+via ``fold_in(shard)``), and the PBFT backend runs unchanged over ``S``
+virtual nodes whose ``alive`` mask is recomputed *every tick* as "shard has an
+elected leader" — a shard only participates in cross-shard consensus while
+its Raft layer is healthy.  Faults (crash/Byzantine/drop) apply within each
+shard; a shard whose leader crashes drops out of the PBFT quorum until
+re-election (clean fidelity re-arms election timers, so representation
+recovers).
+
+Scope note: single-program execution (one chip or one vmapped program); the
+shard axis is embarrassingly parallel so the sweep machinery batches it, but
+``parallel.shard`` row-sharding of the mixed state is not wired up yet.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from blockchain_simulator_tpu.models import pbft, raft
+from blockchain_simulator_tpu.utils.config import FaultConfig
+
+
+@struct.dataclass
+class MixedState:
+    raft: raft.RaftState  # leaves [S, m, ...]
+    pbft: pbft.PbftState  # leaves [S, ...]
+
+
+@struct.dataclass
+class MixedBufs:
+    raft: raft.RaftBufs  # leaves [S, D_raft, m, ...]
+    pbft: pbft.PbftBufs  # leaves [D_pbft, S, ...]
+
+
+def sub_configs(cfg):
+    """(raft_cfg for one m-node shard, pbft_cfg over S representatives)."""
+    s = cfg.mixed_shards
+    m = cfg.n // s
+    rcfg = cfg.with_(protocol="raft", n=m, mesh_axis=None)
+    # faults live at the raft level; representatives fail by losing their
+    # leader, not by an independent fault mask
+    pcfg = cfg.with_(
+        protocol="pbft", n=s, mesh_axis=None, faults=FaultConfig()
+    )
+    return rcfg, pcfg
+
+
+def init(cfg, key=None):
+    s = cfg.mixed_shards
+    if cfg.n % s != 0:
+        raise ValueError(f"n={cfg.n} not divisible into {s} shards")
+    if cfg.n // s < 3:
+        raise ValueError("shard size must be >= 3 for a meaningful raft quorum")
+    rcfg, pcfg = sub_configs(cfg)
+    k = jax.random.key(cfg.seed) if key is None else key
+    shard_keys = jax.vmap(lambda i: jax.random.fold_in(k, i))(jnp.arange(s))
+    r_state, r_bufs = jax.vmap(lambda kk: raft.init(rcfg, kk))(shard_keys)
+    p_state, p_bufs = pbft.init(pcfg, jax.random.fold_in(k, 0x5AFE))
+    # no representative is alive until its shard elects a leader
+    p_state = p_state.replace(alive=jnp.zeros((s,), bool))
+    return MixedState(raft=r_state, pbft=p_state), MixedBufs(raft=r_bufs, pbft=p_bufs)
+
+
+def step(cfg, state: MixedState, bufs: MixedBufs, t, tkey):
+    s = cfg.mixed_shards
+    rcfg, pcfg = sub_configs(cfg)
+    shard_keys = jax.vmap(lambda i: jax.random.fold_in(tkey, 0x0C0C + i))(
+        jnp.arange(s)
+    )
+    r_state, r_bufs = jax.vmap(
+        functools.partial(raft.step, rcfg, t=t)
+    )(state.raft, bufs.raft, tkey=shard_keys)
+    # cross-shard membership: a representative is alive iff its shard
+    # currently has an elected, alive leader
+    has_leader = (r_state.is_leader & r_state.alive).any(axis=1)
+    p_state = state.pbft.replace(alive=has_leader)
+    p_state, p_bufs = pbft.step(
+        pcfg, p_state, bufs.pbft, t, jax.random.fold_in(tkey, 0x9B9B)
+    )
+    return MixedState(raft=r_state, pbft=p_state), MixedBufs(raft=r_bufs, pbft=p_bufs)
+
+
+def metrics(cfg, state: MixedState) -> dict:
+    s = cfg.mixed_shards
+    rcfg, pcfg = sub_configs(cfg)
+    is_leader = np.asarray(state.raft.is_leader) & np.asarray(state.raft.alive)
+    has_leader = is_leader.any(axis=1)
+    block_num = np.asarray(state.raft.block_num)
+    leader_tick = np.asarray(state.raft.leader_tick)
+    # per-shard raft blocks: the earliest-elected current leader's count
+    # (raft.metrics' convention — a deposed ex-leader keeps a stale count)
+    lt = np.where(is_leader, leader_tick, np.iinfo(np.int32).max)
+    lead_idx = lt.argmin(axis=1)
+    shard_blocks = np.where(
+        has_leader, block_num[np.arange(s), lead_idx], 0
+    )
+    pm = pbft.metrics(pcfg, state.pbft)
+    return {
+        "protocol": "mixed",
+        "n": cfg.n,
+        "shards": s,
+        "shard_size": cfg.n // s,
+        "shards_with_leader": int(has_leader.sum()),
+        "raft_blocks_total": int(shard_blocks.sum()),
+        "raft_blocks_min": int(shard_blocks[has_leader].min()) if has_leader.any() else 0,
+        "global_blocks_final": pm["blocks_final_all_nodes"],
+        "global_rounds_sent": pm["rounds_sent"],
+        "global_mean_ttf_ms": pm["mean_time_to_finality_ms"],
+        "agreement_ok": pm["agreement_ok"],
+    }

@@ -65,14 +65,18 @@ Gossip topology (``topology="kregular"``, BASELINE config 3): requests are not
 broadcast — they *flood* over a random k-out digraph (ops/topology.py) with a
 hop TTL.  Channel values carry ``encoded * H + hops_left`` (H = gossip_hops+1,
 so a higher ticket always dominates in the max-combine regardless of TTL); a
-node that sees a new value (per-proposer monotone ``seen`` table — request
-encodings strictly increase per proposer, which is what makes value-dedup
-sound) processes it as an acceptor, replies *directly* to the proposer
-(response overlay — replies are point-to-point in the protocol; gossip is for
-dissemination), and forwards it to its out-neighbors with fresh per-edge
-delays.  Per-tick cost is O(N·deg·P).  Clean-fidelity window timeouts must
-cover the full flood + reply horizon ``(gossip_hops+1) * delay_hi`` (validated
-in ``init``) so the temporal-separation argument still holds.
+node that sees a new request value (per-proposer monotone ``seen`` table —
+request encodings strictly increase per proposer, which is what makes
+value-dedup sound) processes it as an acceptor and replies *directly* to the
+proposer (response overlay — replies are point-to-point in the protocol;
+gossip is for dissemination).  Forwarding triggers on any strictly better
+*TTL-encoded* copy (same value, more hops left), so a fast many-hop path
+delivering a nearly-expired copy first cannot permanently truncate the flood
+— the later fresher copy still propagates.  Per-tick cost is O(N·deg·P).
+Clean-fidelity window timeouts must cover the full flood + reply horizon
+``(gossip_hops+2) * delay_hi`` — up to gossip_hops+1 flood legs (arrival TTLs
+gossip_hops..0) plus the reply leg — validated in ``init`` so the
+temporal-separation argument still holds.
 """
 
 from __future__ import annotations
@@ -112,8 +116,8 @@ class PaxosState:
     commit_tick: jax.Array   # [N] CLIENT COMMIT SUCCESS tick (-1 = never)
     gave_up: jax.Array       # [N] bool — retry budget exhausted
     window_deadline: jax.Array  # [N] clean-fidelity retry timeout tick
-    seen_req: jax.Array      # [N, 3, P] gossip dedup: highest request
-    # encoding seen per (channel, proposer); zeros and unused on full mesh
+    seen_req: jax.Array      # [N, 3, P] gossip dedup: highest TTL-encoded
+    # request copy seen per (channel, proposer); zeros and unused on full mesh
     alive: jax.Array
     honest: jax.Array
 
@@ -314,13 +318,18 @@ def step(cfg, state: PaxosState, bufs: PaxosBufs, t, tkey):
         fwd_vals, proc = [], []
         for ci, arr in enumerate((rt_t, rp_t, rc_t)):
             base, hops = arr // h_enc, arr % h_enc
-            new = (base > seen_req[:, ci, :]) & state.alive[:, None]
-            proc.append(base * new)
-            seen_req = seen_req.at[:, ci, :].max(base * new)
+            seen = seen_req[:, ci, :]
+            # acceptors process each base value once (first sighting) ...
+            new_base = (base > seen // h_enc) & state.alive[:, None]
+            # ... but forward any strictly better TTL-encoded copy, so a
+            # nearly-expired first arrival can't truncate the flood
+            better = (arr > seen) & state.alive[:, None]
+            proc.append(base * new_base)
+            seen_req = seen_req.at[:, ci, :].max(arr * better)
             fwd_vals.append(
-                (base * h_enc + jnp.maximum(hops - 1, 0)) * (new & (hops > 0))
+                (base * h_enc + jnp.maximum(hops - 1, 0)) * (better & (hops > 0))
             )
-        rt_t, rp_t, rc_t = proc  # acceptors process first sightings only
+        rt_t, rp_t, rc_t = proc
 
     # ---- acceptor FSM: concurrent requests serialized in proposer order -----
     t_max, command, t_store = state.t_max, state.command, state.t_store
@@ -523,11 +532,11 @@ def step(cfg, state: PaxosState, bufs: PaxosBufs, t, tkey):
         own = (ids[:, None] == jnp.arange(p)[None, :]).astype(jnp.int32)
         for ci, (val, chan) in enumerate(channels):
             init_mat = val[:, None] * own
-            seen_req = seen_req.at[:, ci, :].max(init_mat)
-            enc = jnp.maximum(
-                fwd_vals[ci],
-                (init_mat * h_enc + cfg.gossip_hops) * (init_mat > 0),
-            )
+            init_enc = (init_mat * h_enc + cfg.gossip_hops) * (init_mat > 0)
+            # the origin marks its own full-TTL copy seen, so no loopback
+            # copy (necessarily fewer hops) is ever re-forwarded
+            seen_req = seen_req.at[:, ci, :].max(init_enc)
+            enc = jnp.maximum(fwd_vals[ci], init_enc)
             contribs.append(gated(
                 (enc > 0).any(),
                 lambda e=enc, c=chan: _gossip_fwd_contrib(

@@ -37,6 +37,11 @@ def make_sharded_sim_fn(cfg: SimConfig, mesh: Mesh):
     """Jitted ``sim(key) -> final_state`` with node state sharded over the
     mesh's ``nodes`` axis.  ``cfg.n`` must divide by the axis size."""
     n_shards = mesh.shape[NODES_AXIS]
+    if cfg.protocol == "mixed":
+        raise NotImplementedError(
+            "row-sharding of the mixed shard-sim state is not wired up; "
+            "batch it over the sweep axis instead"
+        )
     if cfg.n % n_shards != 0:
         raise ValueError(f"n={cfg.n} not divisible by {n_shards} node shards")
     proto = get_protocol(cfg.protocol)

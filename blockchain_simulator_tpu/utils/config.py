@@ -123,6 +123,10 @@ class SimConfig:
     # timeout — a lost reply wedges its proposer forever; reference fidelity
     # reproduces that stall.
 
+    # --- mixed-protocol shard sim (BASELINE config 5) ------------------------
+    mixed_shards: int = 16  # number of raft shards; shard size = n // shards;
+    # cross-shard PBFT runs over the shard representatives
+
     # --- faults --------------------------------------------------------------
     faults: FaultConfig = dataclasses.field(default_factory=FaultConfig)
 
@@ -132,7 +136,7 @@ class SimConfig:
 
     # ------------------------------------------------------------------------
     def __post_init__(self):
-        if self.protocol not in ("pbft", "raft", "paxos"):
+        if self.protocol not in ("pbft", "raft", "paxos", "mixed"):
             raise ValueError(f"unknown protocol {self.protocol!r}")
         if self.delivery not in ("edge", "stat"):
             raise ValueError(f"unknown delivery mode {self.delivery!r}")

@@ -1,0 +1,69 @@
+"""Mixed-protocol shard sim tests (BASELINE config 5: raft shards with
+cross-shard PBFT finality — a capability the reference lacks entirely)."""
+
+import numpy as np
+import pytest
+
+from blockchain_simulator_tpu import SimConfig, run_simulation
+from blockchain_simulator_tpu.runner import final_state
+from blockchain_simulator_tpu.utils.config import FaultConfig
+
+
+CFG = SimConfig(protocol="mixed", n=48, mixed_shards=8, sim_ms=3000)
+
+
+def test_mixed_end_to_end():
+    m = run_simulation(CFG)
+    # every shard elects a raft leader and replicates blocks internally
+    assert m["shards_with_leader"] == 8
+    assert m["raft_blocks_min"] >= 20
+    # the cross-shard PBFT layer finalizes all 40 global blocks
+    assert m["global_blocks_final"] == 40
+    assert m["agreement_ok"]
+    # global finality waits for shard elections (~200 ms) at the start
+    assert 0 < m["global_mean_ttf_ms"] < 1000
+
+
+def test_mixed_determinism():
+    assert run_simulation(CFG) == run_simulation(CFG)
+
+
+def test_mixed_shard_streams_independent():
+    st = final_state(CFG)
+    # distinct per-shard PRNG streams: election outcomes differ across shards
+    lt = np.asarray(st.raft.leader_tick).max(axis=1)
+    assert len(set(lt.tolist())) > 1
+
+
+def test_mixed_membership_follows_raft_health():
+    # crash a majority inside every shard: no shard can elect, the PBFT layer
+    # has no quorum, nothing finalizes
+    cfg = CFG.with_(faults=FaultConfig(n_crashed=4), sim_ms=1500)
+    m = run_simulation(cfg)
+    assert m["shards_with_leader"] == 0
+    assert m["global_blocks_final"] == 0
+
+
+def test_mixed_minority_shard_crashes_tolerated():
+    # 1 crashed node per shard (faults apply within each shard): elections
+    # still succeed and global consensus proceeds
+    cfg = CFG.with_(faults=FaultConfig(n_crashed=1), sim_ms=3000)
+    m = run_simulation(cfg)
+    assert m["shards_with_leader"] == 8
+    assert m["global_blocks_final"] >= 30
+    assert m["agreement_ok"]
+
+
+def test_mixed_validation():
+    with pytest.raises(ValueError, match="divisible"):
+        run_simulation(SimConfig(protocol="mixed", n=50, mixed_shards=8, sim_ms=100))
+    with pytest.raises(ValueError, match="shard size"):
+        run_simulation(SimConfig(protocol="mixed", n=16, mixed_shards=8, sim_ms=100))
+
+
+def test_mixed_sharded_execution_rejected():
+    from blockchain_simulator_tpu.parallel.mesh import make_mesh
+    from blockchain_simulator_tpu.parallel.shard import run_sharded
+
+    with pytest.raises(NotImplementedError):
+        run_sharded(CFG, make_mesh(n_node_shards=4))

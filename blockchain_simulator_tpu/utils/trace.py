@@ -1,0 +1,119 @@
+"""Tracing / observability.
+
+The reference's only observability is timestamped ``NS_LOG_INFO`` lines
+(SURVEY.md §5) read by eye.  Here observability is data, at three levels:
+
+- **End-of-run metrics**: each backend's ``metrics()`` (already structured).
+- **Per-tick time series** (this module): ``run_traced`` scans the simulation
+  with a per-tick probe emitted as ``ys``, returning ``{name: np.ndarray[T]}``
+  — the tensorized equivalent of grepping the reference's log for
+  commit/election/finality lines with timestamps, at zero host-callback cost
+  (the series is device-side until the end).
+- **Profiler capture**: ``profile_run`` wraps a run in ``jax.profiler.trace``
+  for TensorBoard/perfetto (compile + device timeline), the replacement for
+  the pcap/ascii tracing ns-3 offers but the reference never enables.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from blockchain_simulator_tpu.models.base import get_protocol
+from blockchain_simulator_tpu.utils import prng
+from blockchain_simulator_tpu.utils.config import SimConfig
+
+
+def probe(cfg: SimConfig, state) -> dict:
+    """Per-tick scalar probes for a protocol state (device-side, cheap)."""
+    p = cfg.protocol
+    if p == "pbft":
+        return {
+            "blocks_committed_max": state.block_num.max(),
+            "slots_final_min": state.committed.sum(1).min(),
+            "view_max": state.v.max(),
+            "rounds_sent": state.next_n.max(),
+        }
+    if p == "raft":
+        return {
+            "n_leaders": (state.is_leader & state.alive).sum(),
+            "blocks": state.block_num.max(),
+            "elections": state.elections.sum(),
+        }
+    if p == "paxos":
+        return {
+            "executes": state.is_commit.sum(),
+            "max_ticket": state.ticket.max(),
+            "committed_proposers": (state.commit_tick >= 0).sum(),
+        }
+    if p == "mixed":
+        return {
+            "shards_with_leader": (state.raft.is_leader & state.raft.alive)
+            .any(axis=1)
+            .sum(),
+            "raft_blocks_total": state.raft.block_num.max(axis=1).sum(),
+            "global_blocks": state.pbft.block_num.max(),
+        }
+    raise NotImplementedError(p)
+
+
+def run_traced(cfg: SimConfig, seed: int | None = None):
+    """Run one simulation recording the probe every tick.
+
+    Returns ``(metrics, series)`` where ``series`` maps probe names to
+    ``np.ndarray`` of length ``cfg.ticks`` (value *after* each tick).
+    """
+    proto = get_protocol(cfg.protocol)
+
+    @jax.jit
+    def sim(key):
+        state, bufs = proto.init(cfg, jax.random.fold_in(key, 0x1217))
+
+        def body(carry, t):
+            st, bf = carry
+            st, bf = proto.step(cfg, st, bf, t, prng.tick_key(key, t))
+            return (st, bf), probe(cfg, st)
+
+        (state, _), ys = jax.lax.scan(body, (state, bufs), jnp.arange(cfg.ticks))
+        return state, ys
+
+    key = jax.random.key(cfg.seed if seed is None else seed)
+    state, ys = jax.block_until_ready(sim(key))
+    series = {k: np.asarray(v) for k, v in ys.items()}
+    return proto.metrics(cfg, state), series
+
+
+def events_from_series(series: dict, name: str) -> np.ndarray:
+    """Ticks at which a monotone counter series increments — the reconstruction
+    of the reference's per-event log timestamps (e.g. pbft-node.cc:259 commit
+    lines) from the recorded time series."""
+    s = np.asarray(series[name])
+    prev = np.concatenate([[0], s[:-1]])
+    return np.flatnonzero(s > prev)
+
+
+def profile_run(cfg: SimConfig, logdir: str, seed: int | None = None) -> dict:
+    """Capture a profiler trace of one (pre-compiled) run into ``logdir``.
+
+    Returns the run metrics augmented with wallclock timings.  View with
+    TensorBoard's profile plugin or ui.perfetto.dev.
+    """
+    from blockchain_simulator_tpu.runner import make_sim_fn
+
+    proto = get_protocol(cfg.protocol)
+    sim = make_sim_fn(cfg)
+    key = jax.random.key(cfg.seed if seed is None else seed)
+    t0 = time.perf_counter()
+    jax.block_until_ready(sim(key))  # compile + warm outside the capture
+    compile_s = time.perf_counter() - t0
+    with jax.profiler.trace(logdir):
+        t0 = time.perf_counter()
+        final = jax.block_until_ready(sim(key))
+        run_s = time.perf_counter() - t0
+    m = proto.metrics(cfg, final)
+    m["compile_plus_first_run_s"] = compile_s
+    m["profiled_run_s"] = run_s
+    return m

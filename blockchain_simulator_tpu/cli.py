@@ -58,13 +58,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="flood TTL (kregular)")
     p.add_argument("--paxos-timeout-ms", type=int, default=d.paxos_retry_timeout_ms,
                    help="clean-fidelity retry window timeout")
+    p.add_argument("--quorum-rule", choices=["n2", "2f1"], default=d.quorum_rule,
+                   help="n2 = reference majority thresholds (no vote dedup); "
+                        "2f1 = Byzantine-safe 2f+1 quorum with per-sender dedup")
     # faults
     p.add_argument("--crash", type=int, default=-1,
                    help="number of crashed nodes")
     p.add_argument("--byzantine", type=int, default=0,
                    help="number of vote-flipping nodes")
+    p.add_argument("--byz-forge", action="store_true",
+                   help="Byzantine nodes flood forged COMMIT votes for a "
+                        "never-proposed slot (pbft)")
+    p.add_argument("--byz-copies", type=int, default=3,
+                   help="forged vote copies per sender under n2 counting")
     p.add_argument("--drop", type=float, default=0.0,
                    help="per-message drop probability")
+    p.add_argument("--byz-sweep", action="store_true",
+                   help="BASELINE config 4: sweep Byzantine f = 0..(n-1)//3 "
+                        "with vote forging; one JSON line per (f, seed)")
     # per-protocol knobs (reference values as defaults)
     p.add_argument("--pbft-interval-ms", type=int, default=d.pbft_block_interval_ms)
     p.add_argument("--pbft-rounds", type=int, default=d.pbft_max_rounds)
@@ -86,6 +97,7 @@ def config_from_args(args) -> SimConfig:
         seed=args.seed,
         fidelity=args.fidelity,
         delivery=args.delivery,
+        quorum_rule=args.quorum_rule,
         link_delay_ms=args.link_delay_ms,
         topology=args.topology,
         degree=args.degree,
@@ -98,7 +110,11 @@ def config_from_args(args) -> SimConfig:
         paxos_n_proposers=args.paxos_proposers,
         mixed_shards=args.mixed_shards,
         faults=FaultConfig(
-            n_crashed=args.crash, n_byzantine=args.byzantine, drop_prob=args.drop
+            n_crashed=args.crash,
+            n_byzantine=args.byzantine,
+            drop_prob=args.drop,
+            byz_forge=args.byz_forge,
+            byz_copies=args.byz_copies,
         ),
     )
 
@@ -112,16 +128,41 @@ def main(argv=None) -> int:
         if args.shards > 1:
             print("error: --shards requires the jax engine", file=sys.stderr)
             return 2
+        if args.protocol == "mixed":
+            print("error: --protocol mixed requires the jax engine "
+                  "(the C++ engine implements pbft/raft/paxos only)",
+                  file=sys.stderr)
+            return 2
+        if args.topology != "full":
+            print(f"error: --topology {args.topology} requires the jax engine "
+                  "(the C++ engine simulates the full mesh only)",
+                  file=sys.stderr)
+            return 2
+        if args.byz_sweep:
+            print("error: --byz-sweep requires the jax engine",
+                  file=sys.stderr)
+            return 2
         import time
 
         from blockchain_simulator_tpu.engine import run_cpp
 
         for s in seeds:
             t0 = time.perf_counter()
-            m = run_cpp(cfg, seed=s)
+            try:
+                m = run_cpp(cfg, seed=s)
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
             if args.timing:
                 m["wallclock_s"] = time.perf_counter() - t0
             print(json.dumps(m))
+        return 0
+
+    if args.byz_sweep:
+        from blockchain_simulator_tpu.parallel.sweep import run_byzantine_sweep
+
+        for row in run_byzantine_sweep(cfg, seeds=seeds):
+            print(json.dumps(row))
         return 0
 
     if args.timing and (args.shards > 1 or len(seeds) > 1):

@@ -14,8 +14,10 @@ from __future__ import annotations
 
 import ctypes
 import json
+import os
 import pathlib
 import subprocess
+import tempfile
 
 _DIR = pathlib.Path(__file__).resolve().parent
 _SRC = _DIR / "engine.cpp"
@@ -57,17 +59,28 @@ class _CppCfg(ctypes.Structure):
 def build(force: bool = False) -> pathlib.Path:
     """Compile the engine if missing or stale; returns the .so path."""
     if force or not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
-        proc = subprocess.run(
-            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-             "-o", str(_LIB), str(_SRC)],
-            capture_output=True,
-            text=True,
-        )
-        if proc.returncode != 0:
-            raise RuntimeError(
-                f"engine compilation failed (g++ exit {proc.returncode}):\n"
-                f"{proc.stderr}"
+        # compile to a temp file and os.replace() so concurrent builders
+        # (parallel pytest workers, two CLI invocations) never load a
+        # partially written .so — replace is atomic within one directory
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+        os.close(fd)
+        try:
+            proc = subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                 "-o", tmp, str(_SRC)],
+                capture_output=True,
+                text=True,
             )
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"engine compilation failed (g++ exit {proc.returncode}):\n"
+                    f"{proc.stderr}"
+                )
+            os.chmod(tmp, 0o755)  # mkstemp creates 0600; keep the .so loadable
+            os.replace(tmp, _LIB)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
     return _LIB
 
 
@@ -88,7 +101,38 @@ def _lib():
 
 def cpp_config(cfg, seed: int | None = None) -> _CppCfg:
     """Map a ``SimConfig`` onto the engine's flat config struct."""
+    if cfg.protocol not in _PROTOCOLS:
+        raise ValueError(
+            f"the C++ engine implements {sorted(_PROTOCOLS)}; "
+            f"protocol {cfg.protocol!r} is jax-engine only"
+        )
+    if cfg.topology != "full":
+        raise ValueError(
+            "the C++ engine simulates the full mesh only; "
+            f"topology {cfg.topology!r} is jax-engine only"
+        )
+    if cfg.quorum_rule != "n2":
+        raise ValueError(
+            "the C++ engine implements the reference's n2 majority counting "
+            f"only; quorum_rule {cfg.quorum_rule!r} is jax-engine only"
+        )
+    if cfg.faults.byz_forge:
+        raise ValueError(
+            "the C++ engine does not implement the byz_forge attack; "
+            "it is jax-engine only"
+        )
     lo, hi = cfg.one_way_range()
+    if cfg.protocol == "paxos" and cfg.fidelity == "clean":
+        # mirror paxos.init's clean-fidelity invariant (models/paxos.py:144-157):
+        # the engine's temporal-separation safety argument requires stale
+        # same-type replies to drain before a retry window opens
+        _, rt_hi = cfg.roundtrip_range()
+        if cfg.paxos_retry_timeout_ms < rt_hi:
+            raise ValueError(
+                f"paxos_retry_timeout_ms={cfg.paxos_retry_timeout_ms} must be "
+                f">= the max reply horizon ({rt_hi} ms): clean-fidelity "
+                "correctness relies on abandoned windows draining before retry"
+            )
     return _CppCfg(
         protocol=_PROTOCOLS[cfg.protocol],
         n=cfg.n,

@@ -47,10 +47,13 @@ reference adopts whatever command byte rides the window-closing reply,
 paxos-node.cc:264-266, including FAILED replies whose command byte is
 uninitialized stack memory — behavior we do not reproduce).
 
-Echo-back (quirk #1, paxos-node.cc:158) is not modeled: for Paxos it makes
-every packet ping-pong between sender and receiver forever (each reflection is
-itself reflected), so the reference's event queue never drains — the C++
-reference engine exposes it behind a TTL'd flag instead.
+Echo-back (quirk #1, paxos-node.cc:158) is not modeled anywhere in this
+framework — neither here nor in the C++ reference engine (engine.cpp:29-31
+lists it as a deliberate, shared divergence): reflecting every packet to its
+sender makes packets ping-pong forever (each reflection is itself reflected),
+so the upstream event queue never drains, and nothing meaningful depends on
+the echoes (they land in the "wrong msg" default branch).  Differential tests
+therefore compare both backends with echo off (tests/test_differential.py).
 
 Tensorization: proposer fan-in is O(P) with P = ``paxos_n_proposers`` (3), so
 all channels are identity-preserving ``[.., N, P]`` tensors and delivery is
@@ -604,10 +607,17 @@ def metrics(cfg, state: PaxosState) -> dict:
     executed = np.flatnonzero(is_commit & alive)
     exec_cmds = np.unique(command[executed]) if executed.size else np.array([])
     # safety: all executed acceptors executed the same command, and every
-    # committed proposer's value is that command
-    agreement = len(exec_cmds) <= 1 and all(
-        proposal[w] == exec_cmds[0] for w in winners if exec_cmds.size
-    )
+    # committed proposer's value is that command.  A committed proposer with
+    # zero executed acceptors is itself an inconsistency (its commit quorum
+    # claimed executions that nobody holds), not vacuous agreement.
+    if winners.size and not exec_cmds.size:
+        # a committed proposer whose commit quorum left zero executed alive
+        # acceptors claimed executions nobody holds — an inconsistency
+        agreement = False
+    else:
+        agreement = len(exec_cmds) <= 1 and all(
+            proposal[w] == exec_cmds[0] for w in winners
+        )
     return {
         "protocol": "paxos",
         "n": cfg.n,

@@ -21,8 +21,11 @@ Tensorization (SURVEY.md §7): one tick = 1 ms for all N nodes at once.
 - The reference's process-global ``v, n, val, n_round`` (pbft-node.cc:24-30,
   quirk #10 in SURVEY.md §2) become per-node state; a new leader infers the
   next sequence number from the highest PRE_PREPARE slot it has seen.
-- Echo-back (quirk #1) is not modeled in the JAX backend (the C++ reference
-  engine models it exactly; differential tests run with echo off).
+- Echo-back (quirk #1) is a deliberate divergence shared by the JAX backend
+  and the C++ reference engine (engine.cpp:29-31): every echoed packet lands
+  in the reference's "wrong msg" default branch, so dropping the echoes
+  changes traffic volume but no protocol outcome; differential tests pin the
+  echo-off behavior on both backends.
 
 Fidelity modes: ``reference`` keeps N/2 thresholds and reset-on-threshold
 counters (quirks #2, #4 — duplicate commits possible); ``clean`` latches each
@@ -57,6 +60,9 @@ class PbftState:
     prep_sent: jax.Array     # [N, S] bool — COMMIT already broadcast (clean latch)
     committed: jax.Array     # [N, S] bool — slot finalized
     commit_tick: jax.Array   # [N, S] first commit tick, -1 = never
+    propose_tick: jax.Array  # [N, S] tick this node broadcast slot s as leader,
+    # -1 = never (time-to-finality baseline; a view change can stall the
+    # pipeline, so slot k is NOT necessarily proposed at (k+1)*interval)
     block_num: jax.Array     # [N] commits counted (duplicates possible in
     # reference fidelity, matching pbft-node.cc:260)
     view_changes: jax.Array  # [N] view changes initiated
@@ -89,6 +95,7 @@ def init(cfg, key=None):
         prep_sent=zb(n, s),
         committed=zb(n, s),
         commit_tick=jnp.full((n, s), -1, jnp.int32),
+        propose_tick=jnp.full((n, s), -1, jnp.int32),
         block_num=zi(n),
         view_changes=zi(n),
         alive=alive,
@@ -171,26 +178,42 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
 
     # ---- PREPARE_RES arrivals → prepare_vote → COMMIT broadcast -------------
     pv = state.prepare_vote + prep_t
-    crossed_p = (prep_t > 0) & (pv >= cfg.quorum)  # pbft-node.cc:231
+    crossed_p = (prep_t > 0) & (pv >= cfg.pbft_prepare_need)  # pbft-node.cc:231
     if clean:
         crossed_p = crossed_p & ~state.prep_sent
     prep_sent = state.prep_sent | crossed_p
     prepare_vote = jnp.where(crossed_p, 0, pv)  # reset on threshold (quirk #4)
 
+    bt = cfg.pbft_block_interval_ms
+    is_block_tick = (t % bt == 0) & (t > 0)
     commit_send = crossed_p & (state.alive & state.honest)[:, None]
+    commit_mat = commit_send.astype(jnp.int32)
+    if cfg.faults.byz_forge and cfg.faults.n_byzantine > 0:
+        # Active attack: Byzantine nodes flood COMMIT votes for the
+        # never-proposed last slot.  Under "n2" there is no per-sender dedup
+        # (quirk #2): every copy of every re-send lands in the accumulating
+        # counter, so f forgers cross any threshold eventually.  A "2f1"
+        # receiver counts at most one vote per sender *ever*, which is
+        # equivalent to each forger's flood collapsing to a single send.
+        if cfg.quorum_rule == "2f1":
+            fire, copies = jnp.equal(t, bt), 1
+        else:
+            fire, copies = is_block_tick, cfg.faults.byz_copies
+        forgers = (state.alive & ~state.honest).astype(jnp.int32) * jnp.int32(fire)
+        commit_mat = commit_mat.at[:, s - 1].add(forgers * copies)
     k_cm = chan_key(tkey, Channel.DELAY_BCAST)
     zeros_slots = jnp.zeros((hi - lo, n_loc, s), jnp.int32)
     if stat:
         cm_contrib = gated(
-            commit_send.any(),
-            lambda: dv.bcast_slots_stat(k_cm, commit_send, ow_probs, drop, axis=axis),
+            (commit_mat > 0).any(),
+            lambda: dv.bcast_slots_stat(k_cm, commit_mat, ow_probs, drop, axis=axis),
             zeros_slots,
             axis,
         )
     else:
         cm_contrib = gated(
-            commit_send.any(),
-            lambda: dv.bcast_slots_dense(k_cm, commit_send, lo, hi, drop, axis=axis),
+            (commit_mat > 0).any(),
+            lambda: dv.bcast_slots_dense(k_cm, commit_mat, lo, hi, drop, axis=axis),
             zeros_slots,
             axis,
         )
@@ -198,7 +221,7 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
 
     # ---- COMMIT arrivals → commit_vote → finality ---------------------------
     cv = state.commit_vote + com_t
-    crossed_c = (com_t > 0) & (cv > cfg.quorum)  # pbft-node.cc:248
+    crossed_c = (com_t > 0) & (cv >= cfg.pbft_commit_need)  # pbft-node.cc:248
     if clean:
         crossed_c = crossed_c & ~state.committed
     commit_vote = jnp.where(crossed_c, 0, cv)
@@ -209,8 +232,6 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     block_num = state.block_num + crossed_c.sum(axis=1)
 
     # ---- timers: leader block broadcast every 50 ms (SendBlock) -------------
-    bt = cfg.pbft_block_interval_ms
-    is_block_tick = (t % bt == 0) & (t > 0)
     # stop at 40 rounds (pbft-node.cc:407). The reference's n_round is
     # process-global (quirk #10); the per-node analog of global round progress
     # is the sequence number next_n, so a post-view-change leader continues
@@ -240,6 +261,9 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
         )
     pp = ring_push_add(pp, t, lo + ser, pp_contrib)
     rounds_sent = state.rounds_sent + send_block
+    propose_tick = jnp.where(
+        (pp_slot_mat > 0) & (state.propose_tick < 0), jnp.int32(t), state.propose_tick
+    )
     next_n = next_n + send_block
 
     # ---- random view change (P = 1/100 per leader round) --------------------
@@ -283,6 +307,7 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
         prep_sent=prep_sent,
         committed=committed,
         commit_tick=commit_tick,
+        propose_tick=propose_tick,
         block_num=block_num,
         view_changes=view_changes,
     )
@@ -297,23 +322,40 @@ def metrics(cfg, state: PbftState) -> dict:
     committed = np.asarray(state.committed)
     ticks = np.asarray(state.commit_tick)
     alive = np.asarray(state.alive)
+    proposed = np.asarray(state.propose_tick)  # [N, S], -1 = never
+    never_proposed = (proposed < 0).all(axis=0)
     done = committed[alive]
     if done.shape[0] == 0:  # fully-crashed cluster: nothing can finalize
         per_slot_done = np.zeros(done.shape[1], bool)
     else:
-        per_slot_done = done.all(axis=0)
+        # forged slots (finalized but never proposed) are counted separately
+        per_slot_done = done.all(axis=0) & ~never_proposed
     n_final = int(per_slot_done.sum())
-    last = ticks[alive].max() if n_final else -1
-    # time-to-finality per block: commit tick − the tick the block was proposed
+    last = ticks[alive][:, per_slot_done].max() if n_final else -1
+    # time-to-finality per block: last commit tick − the tick the block was
+    # actually proposed (recorded at broadcast; a view change stalls the
+    # pipeline, so (slot+1)*interval would undercount after one)
     rounds = int(np.asarray(state.next_n).max())
     ttf = []
     for slot in range(rounds):
         if per_slot_done[slot]:
-            ttf.append(float(ticks[alive, slot].max()) - (slot + 1) * cfg.pbft_block_interval_ms)
+            pt = proposed[:, slot]
+            pt = pt[pt >= 0]
+            if pt.size:
+                ttf.append(float(ticks[alive, slot].max()) - float(pt.min()))
+    # safety: a slot some alive node finalized although NO node ever proposed
+    # it can only come from forged votes reaching quorum (quirk #2: the
+    # reference's no-dedup counting lets f Byzantine nodes muster f*copies
+    # votes; the 2f1 rule makes this impossible for f <= (n-1)//3)
+    any_committed = committed[alive].any(axis=0) if alive.any() else np.zeros(
+        committed.shape[1], bool
+    )
+    forged_commits = int((any_committed & never_proposed).sum())
     return {
         "protocol": "pbft",
         "n": cfg.n,
         "rounds_sent": rounds,
+        "forged_commits": forged_commits,
         "leader_rounds_max": int(np.asarray(state.rounds_sent).max()),
         "blocks_final_all_nodes": n_final,
         "block_num_max": int(np.asarray(state.block_num).max()),

@@ -297,8 +297,8 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
     # ---- vote responses (candidate side, raft-node.cc:196-232) --------------
     vs = state.vote_success + ok_t * (~state.is_leader)
     vf = state.vote_failed + no_t * (~state.is_leader)
-    win = ~state.is_leader & (ok_t > 0) & (vs + 1 > cfg.quorum) & state.alive
-    lose = ~win & (no_t > 0) & (vf >= cfg.quorum) & ~state.is_leader
+    win = ~state.is_leader & (ok_t > 0) & (vs + 1 >= cfg.majority_need) & state.alive
+    lose = ~win & (no_t > 0) & (vf >= cfg.raft_lose_need) & ~state.is_leader
     vote_success = jnp.where(win | lose, 0, vs)
     vote_failed = jnp.where(win | lose, 0, vf)
     # winner: cancel own timer, first heartbeat NOW, proposals in +1 s
@@ -317,13 +317,13 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
     hs = state.hb_succ + hbok_t
     hc = state.hb_cnt + hbtot_t
     if clean:
-        commit = state.hb_open & (hs + 1 > cfg.quorum) & is_leader
+        commit = state.hb_open & (hs + 1 >= cfg.majority_need) & is_leader
         hb_open = state.hb_open & ~commit
         hb_succ, hb_cnt = hs, hc
     else:
         # reference: the check runs only at exactly N-1 responses in
         done = (hbtot_t > 0) & (hc == n - 1)
-        commit = done & (hs + 1 > cfg.quorum)
+        commit = done & (hs + 1 >= cfg.majority_need)
         hb_succ = jnp.where(done, 0, hs)
         hb_cnt = jnp.where(done, 0, hc)
         hb_open = state.hb_open

@@ -92,17 +92,18 @@ def bcast_value_max_dense(key, send, value, lo, hi, drop_prob=0.0, axis=None):
 
 def bcast_slots_dense(key, slot_mat, lo, hi, drop_prob=0.0, axis=None):
     """Slot-keyed broadcast (e.g. PBFT messages carrying seq no n): sender i
-    broadcasts one message per active slot in ``slot_mat[i, s]`` (0/1).
-    Returns arrival counts per (receiver, slot): [B, N_loc, S].
+    broadcasts ``slot_mat[i, s]`` copies per slot (int counts; >1 only for
+    Byzantine vote flooding).  Returns arrival counts per (receiver, slot):
+    [B, N_loc, S].
 
-    Note: when a sender is active in several slots in the same tick, those
-    broadcasts share one delay draw per edge (a documented simplification; the
-    reference draws per message, pbft-node.cc:364)."""
+    Note: when a sender is active in several slots (or copies) in the same
+    tick, those broadcasts share one delay draw per edge (a documented
+    simplification; the reference draws per message, pbft-node.cc:364)."""
     slot_g = _gather(slot_mat.astype(jnp.int32), axis)
-    send = slot_mat.max(axis=1)
+    send = slot_mat.max(axis=1) > 0
     hits = _edge_hits(
-        key, send, lo, hi, drop_prob, axis, send_global=slot_g.max(axis=1)
-    )  # [B, N_glob, N_loc]
+        key, send, lo, hi, drop_prob, axis, send_global=slot_g.max(axis=1) > 0
+    )  # [B, N_glob, N_loc] 0/1
     return jnp.einsum("bij,is->bjs", hits, slot_g)
 
 

@@ -54,3 +54,31 @@ def run_fault_sweep(cfg: SimConfig, fault_configs, seeds):
     for fc in fault_configs:
         results[fc] = run_seed_sweep(cfg.with_(faults=fc), seeds)
     return results
+
+
+def run_byzantine_sweep(cfg: SimConfig, f_values=None, seeds=(0,), forge=True):
+    """BASELINE config 4 end-to-end: sweep the Byzantine count f over
+    ``f_values`` (default 0..(n-1)//3), seeds batched per f.
+
+    Each entry reports the two safety-relevant outcomes next to the fault
+    level: ``forged_commits`` (a slot finalized although no honest leader ever
+    proposed it — possible under the reference's no-dedup "n2" counting, see
+    utils/config.py quorum_rule) and ``agreement_ok``.  Returns a list of
+    {"f": f, "seed": s, **metrics} dicts.
+    """
+    import dataclasses
+
+    if forge and cfg.protocol != "pbft":
+        raise ValueError(
+            "the forging attack is implemented for pbft only; pass "
+            "forge=False to sweep passive vote-flipping Byzantine nodes "
+            f"for {cfg.protocol!r}"
+        )
+    if f_values is None:
+        f_values = range(cfg.byz_f + 1)
+    out = []
+    for f in f_values:
+        faults = dataclasses.replace(cfg.faults, n_byzantine=f, byz_forge=forge)
+        for seed, m in zip(seeds, run_seed_sweep(cfg.with_(faults=faults), seeds)):
+            out.append({"f": int(f), "seed": int(seed), **m})
+    return out

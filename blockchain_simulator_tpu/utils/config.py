@@ -38,6 +38,14 @@ class FaultConfig:
     # Number of Byzantine nodes (vote-flippers): their SUCCESS votes are
     # delivered as FAILED and vice versa. Chosen as the last ids.
     n_byzantine: int = 0
+    # Active Byzantine attack (PBFT): forgers broadcast COMMIT votes for a
+    # slot no honest leader ever proposed (the last slot index).  With the
+    # reference's counting — no per-sender vote dedup, SURVEY.md quirk #2 —
+    # each forger's vote counts ``byz_copies`` times, so f forgers muster
+    # f*byz_copies forged votes; a ``quorum_rule="2f1"`` node deduplicates by
+    # sender id, capping each forger at one counted vote.
+    byz_forge: bool = False
+    byz_copies: int = 3
 
     def resolved_n_crashed(self, n: int) -> int:
         if self.n_crashed >= 0:
@@ -85,6 +93,17 @@ class SimConfig:
     # "clean":     documented fixes (latched commits, re-armed timers, N-1
     #              counting, highest-command adoption).
     fidelity: str = "clean"
+    # Quorum rule for PBFT/Raft vote thresholds (SURVEY.md quirk #2; BASELINE
+    # config 4 sweeps f up to n/3, where the reference's simple-majority rule
+    # is not Byzantine-safe):
+    # "n2":  the reference's thresholds — PBFT prepare >= N/2, commit > N/2
+    #        (pbft-node.cc:231,248), Raft votes+self > N/2 (raft-node.cc:209)
+    #        — and no per-sender vote deduplication.
+    # "2f1": Byzantine-safe 2f+1 quorum with f = (n-1)//3, votes deduplicated
+    #        per sender: any two quorums intersect in >= f+1 nodes, hence in
+    #        an honest node, so no two honest nodes finalize different blocks
+    #        and forged vote floods cannot reach quorum.
+    quorum_rule: str = "n2"
 
     # --- PBFT (pbft-node.cc) -------------------------------------------------
     pbft_block_interval_ms: int = 50  # timeout=0.05 (pbft-node.cc:106)
@@ -142,6 +161,26 @@ class SimConfig:
             raise ValueError(f"unknown delivery mode {self.delivery!r}")
         if self.fidelity not in ("reference", "clean"):
             raise ValueError(f"unknown fidelity {self.fidelity!r}")
+        if self.quorum_rule not in ("n2", "2f1"):
+            raise ValueError(f"unknown quorum_rule {self.quorum_rule!r}")
+        if self.quorum_rule == "2f1" and self.fidelity != "clean":
+            raise ValueError(
+                "quorum_rule='2f1' requires fidelity='clean': vote dedup "
+                "relies on the clean latches (each node votes once per slot); "
+                "the reference's reset-on-threshold counters re-count"
+            )
+        if self.faults.byz_forge:
+            if self.protocol != "pbft":
+                raise ValueError(
+                    "byz_forge (forged COMMIT-vote flooding) is a PBFT attack; "
+                    f"protocol {self.protocol!r} does not implement it"
+                )
+            if self.pbft_max_rounds >= self.pbft_max_slots:
+                raise ValueError(
+                    "byz_forge targets the last vote-table slot; "
+                    "pbft_max_rounds must be < pbft_max_slots so no honest "
+                    "leader ever proposes it"
+                )
         if self.topology not in ("full", "kregular"):
             raise ValueError(f"unknown topology {self.topology!r}")
         if not 1 <= self.paxos_n_proposers <= self.n:
@@ -210,6 +249,44 @@ class SimConfig:
         """The reference's majority threshold N/2 (pbft-node.cc:231,248;
         raft-node.cc:209; paxos-node.cc:259) — integer division, *not* 2f+1."""
         return self.n // 2
+
+    @property
+    def byz_f(self) -> int:
+        """Max tolerable Byzantine count under the 2f+1 rule: f = (n-1)//3."""
+        return (self.n - 1) // 3
+
+    @property
+    def pbft_prepare_need(self) -> int:
+        """Votes needed to cross the prepare phase (>= semantics).
+        n2: prepare_vote >= N/2 (pbft-node.cc:231)."""
+        if self.quorum_rule == "2f1":
+            return 2 * self.byz_f + 1
+        return self.quorum
+
+    @property
+    def pbft_commit_need(self) -> int:
+        """Votes needed to finalize (>= semantics).
+        n2: commit_vote > N/2 (pbft-node.cc:248) ⇔ >= N/2 + 1."""
+        if self.quorum_rule == "2f1":
+            return 2 * self.byz_f + 1
+        return self.quorum + 1
+
+    @property
+    def majority_need(self) -> int:
+        """Raft votes (including self) needed to win / commit (>= semantics).
+        n2: votes + self > N/2 (raft-node.cc:209)."""
+        if self.quorum_rule == "2f1":
+            return 2 * self.byz_f + 1
+        return self.quorum + 1
+
+    @property
+    def raft_lose_need(self) -> int:
+        """FAILED votes at which a candidate abandons the election
+        (>= semantics).  n2: vote_failed >= N/2 (raft-node.cc:225); 2f1: the
+        election is unwinnable once n - vote_failed < majority_need."""
+        if self.quorum_rule == "2f1":
+            return self.n - self.majority_need + 1
+        return self.quorum
 
     @property
     def pbft_block_txs(self) -> int:

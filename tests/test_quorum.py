@@ -1,0 +1,87 @@
+"""Byzantine-safe quorum rule (BASELINE config 4; SURVEY.md quirk #2).
+
+The reference's thresholds are simple majorities — ``prepare_vote >= N/2``
+(pbft-node.cc:231), ``commit_vote > N/2`` (pbft-node.cc:248) — with no
+per-sender vote deduplication, so f Byzantine nodes re-sending COMMIT votes
+accumulate unbounded counts.  ``quorum_rule="2f1"`` switches PBFT/Raft to the
+Byzantine-safe 2f+1 quorum with per-sender dedup (utils/config.py).
+"""
+
+import numpy as np
+import pytest
+
+from blockchain_simulator_tpu.parallel.sweep import run_byzantine_sweep
+from blockchain_simulator_tpu.runner import run_simulation
+from blockchain_simulator_tpu.utils.config import FaultConfig, SimConfig
+
+
+def _cfg(rule, **fault_kw):
+    return SimConfig(
+        protocol="pbft",
+        n=8,
+        sim_ms=1000,
+        pbft_max_rounds=16,
+        pbft_max_slots=32,
+        quorum_rule=rule,
+        faults=FaultConfig(**fault_kw),
+    )
+
+
+def test_thresholds():
+    cfg = _cfg("n2")
+    assert cfg.pbft_prepare_need == 4 and cfg.pbft_commit_need == 5
+    assert cfg.majority_need == 5 and cfg.raft_lose_need == 4
+    cfg = _cfg("2f1")
+    assert cfg.byz_f == 2
+    assert cfg.pbft_prepare_need == 5 and cfg.pbft_commit_need == 5
+    assert cfg.majority_need == 5 and cfg.raft_lose_need == 4
+
+
+def test_2f1_requires_clean_fidelity():
+    with pytest.raises(ValueError, match="fidelity"):
+        SimConfig(protocol="pbft", quorum_rule="2f1", fidelity="reference")
+
+
+def test_forge_requires_spare_slot():
+    with pytest.raises(ValueError, match="pbft_max_rounds"):
+        SimConfig(
+            protocol="pbft",
+            pbft_max_rounds=16,
+            pbft_max_slots=16,
+            faults=FaultConfig(n_byzantine=1, byz_forge=True),
+        )
+
+
+def test_n2_forgeable_2f1_safe():
+    """The headline safety separation: one vote-flooding Byzantine node forges
+    a never-proposed block past the reference's no-dedup majority counting,
+    while the 2f+1 rule (dedup ⇒ at most f counted forged votes < quorum)
+    never finalizes it.  Honest finality is preserved in both."""
+    faults = dict(n_byzantine=1, byz_forge=True, byz_copies=5)
+    m_n2 = run_simulation(_cfg("n2", **faults))
+    assert m_n2["forged_commits"] == 1
+    assert m_n2["blocks_final_all_nodes"] > 0  # attack is silent, not a DoS
+    m_21 = run_simulation(_cfg("2f1", **faults))
+    assert m_21["forged_commits"] == 0
+    assert m_21["blocks_final_all_nodes"] > 0
+    assert m_21["agreement_ok"]
+
+
+def test_byzantine_sweep_config4():
+    """BASELINE config 4 end-to-end (scaled down): sweep f = 0..(n-1)//3.
+    Under 2f1 no forged block ever finalizes at any tolerable f; under n2 the
+    flood succeeds for every f >= 1."""
+    base = _cfg("2f1")
+    rows = run_byzantine_sweep(base, seeds=(0, 1))
+    assert len(rows) == (base.byz_f + 1) * 2
+    assert all(r["forged_commits"] == 0 for r in rows)
+    assert all(r["agreement_ok"] for r in rows)
+    rows_n2 = run_byzantine_sweep(_cfg("n2"), f_values=[1, 2], seeds=(0,))
+    assert all(r["forged_commits"] >= 1 for r in rows_n2)
+
+
+def test_raft_2f1_still_elects():
+    cfg = SimConfig(protocol="raft", n=8, sim_ms=3000, quorum_rule="2f1")
+    m = run_simulation(cfg)
+    assert m["n_leaders"] >= 1
+    assert m["blocks"] > 0

@@ -107,6 +107,46 @@ def bcast_slots_dense(key, slot_mat, lo, hi, drop_prob=0.0, axis=None):
     return jnp.einsum("bij,is->bjs", hits, slot_g)
 
 
+def bcast_window_value_max_dense(key, value_mat, lo, hi, drop_prob=0.0, axis=None):
+    """Per-window value broadcast (PBFT PRE_PREPARE carrying the slot id):
+    sender i announces ``value_mat[i, w]`` (>0; 0 = empty) for window w; the
+    receiver max-combines per window.  Returns [B, N_loc, W].
+
+    Windows of one sender share one delay draw per edge (same simplification
+    as bcast_slots_dense)."""
+    value_g = _gather(value_mat.astype(jnp.int32), axis)  # [N_glob, W]
+    send = value_mat.max(axis=1) > 0
+    hits = _edge_hits(
+        key, send, lo, hi, drop_prob, axis, send_global=value_g.max(axis=1) > 0
+    )  # [B, N_glob, N_loc] 0/1
+    return (hits[:, :, :, None] * value_g[None, :, None, :]).max(axis=1)
+
+
+def bcast_window_value_max_stat(key, value_mat, probs: np.ndarray, drop_prob=0.0,
+                                axis=None):
+    """Stat version of bcast_window_value_max_dense for few senders per
+    window (the PBFT leader): deliver each window's max announced value with
+    one independent per-(receiver, window) delay draw.  A receiver whose own
+    announcement equals the max is the sender — it gets nothing (the
+    reference leader never hears its own PRE_PREPARE).  Returns [B, N_loc, W]."""
+    k = _shard_key(key, axis)
+    vm = value_mat.astype(jnp.int32)
+    n, w = vm.shape
+    vmax = vm.max(axis=0)  # [W]
+    if axis is not None:
+        vmax = lax.pmax(vmax, axis)
+    nb = len(probs)
+    d = jax.random.categorical(k, jnp.log(jnp.asarray(probs) + 1e-30), shape=(n, w))
+    recv = (vmax[None, :] > 0) & (vm < vmax[None, :])
+    if drop_prob > 0.0:
+        keep = jax.random.bernoulli(
+            jax.random.fold_in(k, 0x0D14), 1.0 - drop_prob, (n, w)
+        )
+        recv = recv & keep
+    val = recv.astype(jnp.int32) * vmax[None, :]
+    return jnp.stack([(d == b).astype(jnp.int32) * val for b in range(nb)])
+
+
 def roundtrip_reply_counts_dense(
     key, send, lo, hi, drop_prob=0.0, peer_mask=None, axis=None
 ):

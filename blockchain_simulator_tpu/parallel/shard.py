@@ -22,10 +22,19 @@ from blockchain_simulator_tpu.utils import prng
 from blockchain_simulator_tpu.utils.config import SimConfig
 
 
-def node_specs(state, bufs):
-    """PartitionSpecs: state leaves are [N, ...] (shard dim 0), buffer leaves
-    are [D, N, ...] (shard dim 1)."""
-    state_spec = jax.tree.map(lambda x: P(NODES_AXIS, *([None] * (x.ndim - 1))), state)
+def node_specs(state, bufs, global_fields=()):
+    """PartitionSpecs: state leaves are [N, ...] (shard dim 0) except the
+    protocol's ``GLOBAL_FIELDS`` (per-slot accumulators, replicated spec —
+    each shard carries a partial that the protocol's ``finalize`` combines);
+    buffer leaves are [D, N, ...] (shard dim 1)."""
+
+    def state_leaf_spec(path, x):
+        name = path[-1].name if hasattr(path[-1], "name") else None
+        if name in global_fields:
+            return P(*([None] * x.ndim))
+        return P(NODES_AXIS, *([None] * (x.ndim - 1)))
+
+    state_spec = jax.tree_util.tree_map_with_path(state_leaf_spec, state)
     bufs_spec = jax.tree.map(
         lambda x: P(None, NODES_AXIS, *([None] * (x.ndim - 2))), bufs
     )
@@ -48,7 +57,9 @@ def make_sharded_sim_fn(cfg: SimConfig, mesh: Mesh):
     cfg_local = cfg.with_(mesh_axis=NODES_AXIS)
 
     state0, bufs0 = jax.eval_shape(lambda: proto.init(cfg, jax.random.key(0)))
-    state_spec, bufs_spec = node_specs(state0, bufs0)
+    state_spec, bufs_spec = node_specs(
+        state0, bufs0, getattr(proto, "GLOBAL_FIELDS", ())
+    )
 
     def run(key, state, bufs):
         def body(carry, t):
@@ -57,6 +68,8 @@ def make_sharded_sim_fn(cfg: SimConfig, mesh: Mesh):
             return (st, bf), ()
 
         (state, bufs), _ = jax.lax.scan(body, (state, bufs), jnp.arange(cfg.ticks))
+        if hasattr(proto, "finalize"):
+            state = proto.finalize(state, NODES_AXIS)
         return state
 
     shmapped = jax.shard_map(

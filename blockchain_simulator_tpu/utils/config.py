@@ -116,6 +116,13 @@ class SimConfig:
     pbft_view_change_den: int = 100  # (rand()%100==5, pbft-node.cc:401)
     pbft_max_slots: int = 64  # vote-table slots (tx[1000], pbft-node.h:50; 40
     # rounds only ever touch slots 0..39)
+    pbft_window: int = 0  # live vote-state window W: per-node vote counters
+    # live in [N, W] keyed by slot % W and are evicted on re-tenancy, capping
+    # per-tick memory traffic at O(N·W) instead of O(N·S) (the 100k-node
+    # scaling lever).  0 (default) = W = pbft_max_slots = exact full-table
+    # mode.  A window is safe when W * block_interval far exceeds the message
+    # horizon (validated in pbft.init); per-slot metrics are exact in both
+    # modes (they fold into [S] accumulators either way).
 
     # --- Raft (raft-node.cc) -------------------------------------------------
     raft_heartbeat_ms: int = 50  # heartbeat_timeout=0.05 (raft-node.cc:80)

@@ -33,7 +33,7 @@ def probe(cfg: SimConfig, state) -> dict:
     if p == "pbft":
         return {
             "blocks_committed_max": state.block_num.max(),
-            "slots_final_min": state.committed.sum(1).min(),
+            "commit_events_total": state.slot_commits.sum(),
             "view_max": state.v.max(),
             "rounds_sent": state.next_n.max(),
         }

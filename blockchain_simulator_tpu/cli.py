@@ -44,6 +44,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fidelity", choices=["reference", "clean"],
                    default=d.fidelity)
     p.add_argument("--delivery", choices=["edge", "stat"], default=d.delivery)
+    p.add_argument("--stat-sampler", choices=["exact", "normal", "auto"],
+                   default=d.stat_sampler,
+                   help="binomial sampler for stat-delivery bucket counts: "
+                        "exact = BTRS rejection; normal = Gaussian "
+                        "approximation (fast at large n); auto = by n")
     p.add_argument("--engine", choices=["jax", "cpp"], default="jax",
                    help="jax = tensorized TPU backend; cpp = serial "
                         "per-message C++ reference engine")
@@ -97,6 +102,7 @@ def config_from_args(args) -> SimConfig:
         seed=args.seed,
         fidelity=args.fidelity,
         delivery=args.delivery,
+        stat_sampler=args.stat_sampler,
         quorum_rule=args.quorum_rule,
         link_delay_ms=args.link_delay_ms,
         topology=args.topology,

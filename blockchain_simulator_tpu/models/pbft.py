@@ -199,6 +199,7 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     drop = cfg.faults.drop_prob
     clean = cfg.fidelity == "clean"
     stat = cfg.delivery == "stat"
+    smode = cfg.eff_stat_sampler
     ow_probs = delay_ops.uniform_probs(lo, hi)
     rt_probs = delay_ops.roundtrip_probs(lo, hi)
     n_loc = state.v.shape[0]
@@ -247,7 +248,7 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
             prep_active.any(),
             lambda: dv.roundtrip_reply_counts_stat(
                 k_rt, prep_active, n_voters - voters.astype(jnp.int32), rt_probs,
-                drop, axis=axis,
+                drop, axis=axis, mode=smode,
             ),
             jnp.zeros((len(rt_probs), n_loc), jnp.int32),
             axis,
@@ -296,7 +297,8 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     if stat:
         cm_contrib = gated(
             (commit_mat > 0).any(),
-            lambda: dv.bcast_slots_stat(k_cm, commit_mat, ow_probs, drop, axis=axis),
+            lambda: dv.bcast_slots_stat(k_cm, commit_mat, ow_probs, drop, axis=axis,
+                                        mode=smode),
             zeros_w,
             axis,
         )

@@ -169,6 +169,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
     drop = cfg.faults.drop_prob
     clean = cfg.fidelity == "clean"
     stat = cfg.delivery == "stat"
+    smode = cfg.eff_stat_sampler
     ow_probs = delay_ops.uniform_probs(lo, hi)
     rt_probs = delay_ops.roundtrip_probs(lo, hi)
     n_loc = state.is_leader.shape[0]
@@ -244,16 +245,16 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
             mno = reply_counts(no_wire)
             if drop > 0.0:
                 kd = jax.random.fold_in(k_vr, 0x0D17)
-                mok = jnp.round(jax.random.binomial(
-                    kd, mok.astype(jnp.float32), 1.0 - drop)).astype(jnp.int32)
-                mno = jnp.round(jax.random.binomial(
-                    jax.random.fold_in(kd, 1), mno.astype(jnp.float32),
-                    1.0 - drop)).astype(jnp.int32)
+                mok = jnp.round(delay_ops.binom(
+                    kd, mok, 1.0 - drop, smode)).astype(jnp.int32)
+                mno = jnp.round(delay_ops.binom(
+                    jax.random.fold_in(kd, 1), mno, 1.0 - drop,
+                    smode)).astype(jnp.int32)
             return jnp.stack([
                 delay_ops.sample_bucket_counts(
-                    jax.random.fold_in(k_vr, 7), mok, ow_probs),
+                    jax.random.fold_in(k_vr, 7), mok, ow_probs, smode),
                 delay_ops.sample_bucket_counts(
-                    jax.random.fold_in(k_vr, 8), mno, ow_probs),
+                    jax.random.fold_in(k_vr, 8), mno, ow_probs, smode),
             ])
 
         both = gated(
@@ -406,7 +407,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
             lambda: dv.bcast_counts_stat(
                 k_hb,
                 _psum_scalar(plain_send.astype(jnp.int32).sum(), axis),
-                plain_send, ow_probs, drop, axis=axis),
+                plain_send, ow_probs, drop, axis=axis, mode=smode),
             zeros_flat,
             axis,
         )
@@ -455,7 +456,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
             prop_send.any(),
             lambda: dv.roundtrip_reply_counts_stat(
                 k_rt, prop_send, n_voters - voters.astype(jnp.int32),
-                rt_probs, drop, axis=axis),
+                rt_probs, drop, axis=axis, mode=smode),
             zeros_rt,
             axis,
         )
@@ -464,7 +465,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
             lambda: dv.roundtrip_reply_counts_stat(
                 jax.random.fold_in(k_rt, 1), prop_send,
                 n_liars - liars.astype(jnp.int32), rt_probs, drop,
-                axis=axis),
+                axis=axis, mode=smode),
             zeros_rt,
             axis,
         )

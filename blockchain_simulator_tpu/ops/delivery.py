@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from blockchain_simulator_tpu.ops.delay import sample_bucket_counts, sample_edge_delays
+from blockchain_simulator_tpu.ops.delay import binom, sample_bucket_counts, sample_edge_delays
 
 
 def _shard_key(key, axis):
@@ -230,7 +230,8 @@ def bcast_matrix_dense(key, send, value, lo, hi, drop_prob=0.0, axis=None):
 # --------------------------------------------------------------------------- #
 
 
-def bcast_counts_stat(key, n_senders, is_sender, probs: np.ndarray, drop_prob=0.0, axis=None):
+def bcast_counts_stat(key, n_senders, is_sender, probs: np.ndarray, drop_prob=0.0, axis=None,
+                      mode="exact"):
     """Full-mesh broadcast arrival counts without materializing edges.
 
     Each receiver j hears from ``n_senders - is_sender[j]`` peers; its arrival
@@ -242,14 +243,13 @@ def bcast_counts_stat(key, n_senders, is_sender, probs: np.ndarray, drop_prob=0.
     m = jnp.asarray(n_senders, jnp.int32) - is_sender.astype(jnp.int32)
     if drop_prob > 0.0:
         m = jnp.round(
-            jax.random.binomial(
-                jax.random.fold_in(k, 0x0D10), m.astype(jnp.float32), 1.0 - drop_prob
-            )
+            binom(jax.random.fold_in(k, 0x0D10), m, 1.0 - drop_prob, mode)
         ).astype(jnp.int32)
-    return sample_bucket_counts(k, m, probs)
+    return sample_bucket_counts(k, m, probs, mode)
 
 
-def bcast_slots_stat(key, slot_mat, probs: np.ndarray, drop_prob=0.0, axis=None):
+def bcast_slots_stat(key, slot_mat, probs: np.ndarray, drop_prob=0.0, axis=None,
+                     mode="exact"):
     """Stat version of bcast_slots_dense: receiver j hears, per slot s,
     from ``(Σ_i slot_mat[i,s]) - slot_mat[j,s]`` senders; arrival buckets are
     multinomial per (receiver, slot).  Returns [B, N_loc, S]."""
@@ -261,11 +261,9 @@ def bcast_slots_stat(key, slot_mat, probs: np.ndarray, drop_prob=0.0, axis=None)
     m = totals[None, :] - sm  # [N_loc, S]
     if drop_prob > 0.0:
         m = jnp.round(
-            jax.random.binomial(
-                jax.random.fold_in(k, 0x0D12), m.astype(jnp.float32), 1.0 - drop_prob
-            )
+            binom(jax.random.fold_in(k, 0x0D12), m, 1.0 - drop_prob, mode)
         ).astype(jnp.int32)
-    return sample_bucket_counts(k, m, probs)
+    return sample_bucket_counts(k, m, probs, mode)
 
 
 def bcast_value_max_stat(key, value, probs: np.ndarray, drop_prob=0.0, axis=None):
@@ -291,7 +289,7 @@ def bcast_value_max_stat(key, value, probs: np.ndarray, drop_prob=0.0, axis=None
 
 
 def roundtrip_reply_counts_stat(
-    key, send, n_peers, rt_probs: np.ndarray, drop_prob=0.0, axis=None
+    key, send, n_peers, rt_probs: np.ndarray, drop_prob=0.0, axis=None, mode="exact"
 ):
     """Stat version of roundtrip_reply_counts_dense: each active sender gets
     ``n_peers`` (global count, per local sender) replies multinomially spread
@@ -301,8 +299,6 @@ def roundtrip_reply_counts_stat(
     if drop_prob > 0.0:
         p_keep = (1.0 - drop_prob) ** 2
         m = jnp.round(
-            jax.random.binomial(
-                jax.random.fold_in(k, 0x0D11), m.astype(jnp.float32), p_keep
-            )
+            binom(jax.random.fold_in(k, 0x0D11), m, p_keep, mode)
         ).astype(jnp.int32)
-    return sample_bucket_counts(k, m, rt_probs)
+    return sample_bucket_counts(k, m, rt_probs, mode)

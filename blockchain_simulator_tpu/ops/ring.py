@@ -18,25 +18,42 @@ Channels come in two flavors (SURVEY.md §7 "variable-size inboxes"):
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
 def ring_pop(buf, t):
     """Read and clear the current tick's slice. Returns (slice, buf')."""
     idx = jnp.mod(t, buf.shape[0])
-    cur = buf[idx]
-    return cur, buf.at[idx].set(0)
+    cur = jax.lax.dynamic_index_in_dim(buf, idx, 0, keepdims=False)
+    return cur, jax.lax.dynamic_update_index_in_dim(
+        buf, jnp.zeros_like(cur), idx, 0
+    )
 
 
-def _idx(buf, t, lo, nb):
-    return jnp.mod(t + lo + jnp.arange(nb), buf.shape[0])
+def _push(buf, t, lo: int, contrib, combine):
+    """Combine ``contrib[b, ...]`` into slices ``t+lo+b``, b in [0, B).
+
+    Unrolled over the (small, static) bucket axis as dynamic-slice /
+    dynamic-update-slice pairs: a ``buf.at[idx_vector].add`` lowers to XLA
+    generic scatter, which TPUs execute catastrophically slowly — the round-3
+    ablation (tools/ablate.py) measured the scatter form at ~2.0 ms/tick of a
+    2.24 ms/tick total at N=100k; the DUS form is ~30x faster.  In-place
+    update is preserved (each step is a DUS on the scan-carried buffer).
+    """
+    d = buf.shape[0]
+    for b in range(contrib.shape[0]):
+        idx = jnp.mod(t + lo + b, d)
+        cur = jax.lax.dynamic_index_in_dim(buf, idx, 0, keepdims=False)
+        buf = jax.lax.dynamic_update_index_in_dim(buf, combine(cur, contrib[b]), idx, 0)
+    return buf
 
 
 def ring_push_add(buf, t, lo: int, contrib):
-    """Scatter-add ``contrib[b, ...]`` into slices ``t+lo+b``, b in [0, B)."""
-    return buf.at[_idx(buf, t, lo, contrib.shape[0])].add(contrib)
+    """Add ``contrib[b, ...]`` into slices ``t+lo+b``, b in [0, B)."""
+    return _push(buf, t, lo, contrib, lambda cur, c: cur + c)
 
 
 def ring_push_max(buf, t, lo: int, contrib):
-    """Scatter-max (for value channels where 0 == empty)."""
-    return buf.at[_idx(buf, t, lo, contrib.shape[0])].max(contrib)
+    """Max-combine (for value channels where 0 == empty)."""
+    return _push(buf, t, lo, contrib, jnp.maximum)

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from blockchain_simulator_tpu.models.base import get_protocol
 from blockchain_simulator_tpu.utils import prng
 from blockchain_simulator_tpu.utils.config import SimConfig
+from blockchain_simulator_tpu.utils.sync import force_sync
 
 
 @functools.lru_cache(maxsize=64)
@@ -49,8 +50,12 @@ def run_simulation(cfg: SimConfig, seed: int | None = None, with_timing: bool = 
     proto = get_protocol(cfg.protocol)
     sim = make_sim_fn(cfg)
     key = jax.random.key(cfg.seed if seed is None else seed)
+    if with_timing:
+        force_sync(sim(key))  # compile + warm so the timed run is execution only
     t0 = time.perf_counter()
-    final = jax.block_until_ready(sim(key))
+    # force_sync, not block_until_ready: the latter returns before execution
+    # completes on this env's axon backend (KNOWN_ISSUES.md #1)
+    final = force_sync(sim(key))
     wall = time.perf_counter() - t0
     m = proto.metrics(cfg, final)
     if with_timing:

@@ -87,6 +87,15 @@ class SimConfig:
     #         counts drawn from binomial/multinomial chains (O(N·B)); valid for
     #         full-mesh count-consumed channels; the 100k-node path.
     delivery: str = "edge"
+    # Binomial sampler for "stat" delivery bucket counts (ops/delay.py):
+    # "exact"  — BTRS rejection sampling (jax.random.binomial).
+    # "normal" — Gaussian approximation: ~6x fewer elementwise passes; counts
+    #            still sum exactly (every message delivered exactly once),
+    #            only the spread across delay buckets is approximate with
+    #            relative error O(1/sqrt(count)).
+    # "auto"   — "normal" when n >= 4096 (where the error is negligible and
+    #            the tick loop is sampler-bound), else "exact".
+    stat_sampler: str = "auto"
     # "reference": replicate the reference's observable quirks (N/2 thresholds,
     #              reset-on-threshold vote counters, never-re-armed Raft
     #              election timer, N-2 Paxos reply counting).
@@ -168,6 +177,8 @@ class SimConfig:
             raise ValueError(f"unknown delivery mode {self.delivery!r}")
         if self.fidelity not in ("reference", "clean"):
             raise ValueError(f"unknown fidelity {self.fidelity!r}")
+        if self.stat_sampler not in ("exact", "normal", "auto"):
+            raise ValueError(f"unknown stat_sampler {self.stat_sampler!r}")
         if self.quorum_rule not in ("n2", "2f1"):
             raise ValueError(f"unknown quorum_rule {self.quorum_rule!r}")
         if self.quorum_rule == "2f1" and self.fidelity != "clean":
@@ -207,6 +218,13 @@ class SimConfig:
                 )
 
     # --- derived quantities (plain python; all static under jit) ------------
+    @property
+    def eff_stat_sampler(self) -> str:
+        """Resolved stat_sampler ('auto' -> by cluster size)."""
+        if self.stat_sampler == "auto":
+            return "normal" if self.n >= 4096 else "exact"
+        return self.stat_sampler
+
     @property
     def ticks(self) -> int:
         """Total simulation ticks (1 tick = 1 ms)."""

@@ -1,0 +1,42 @@
+"""Forced device synchronization for trustworthy wall-clock timing.
+
+On this environment's tunneled TPU backend (the "axon" PJRT plugin),
+``jax.block_until_ready`` can return before the device has actually finished
+executing: round-2 measurements showed a 100k-node simulation "completing" in
+~4 ms of wall time while quadrupling the tick count barely moved the clock
+(sub-microsecond per tick — physically impossible), and forcing a scalar
+readback of the result put the true time at ~4.8 s.  Every timing path in
+this package therefore goes through :func:`force_sync`, which transfers one
+scalar derived from (every leaf of) the result to the host — a data
+dependency no conforming runtime can satisfy before execution is complete.
+
+This is strictly stronger than ``block_until_ready`` and costs one tiny
+device-to-host transfer, which is noise at the timescales being measured.
+See KNOWN_ISSUES.md for the full evidence trail.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def force_sync(tree):
+    """Block until ``tree`` (any pytree of arrays) is fully materialized.
+
+    Returns ``tree`` unchanged, so timing code can write
+    ``result = force_sync(fn(args))``.
+
+    One readback suffices even for a many-leaf result: all outputs of a jitted
+    call come from one XLA execution, so any output buffer being transferable
+    implies the whole execution retired.  (Round-3 measurement: each readback
+    costs ~70 ms over the tunnel, so per-leaf sync would add ~1.2 s of
+    constant overhead to every timing.)
+    """
+    jax.block_until_ready(tree)  # cheap first pass; correct on conforming backends
+    for leaf in jax.tree_util.tree_leaves(tree):
+        x = jnp.asarray(leaf)
+        if x.size:
+            float(jnp.ravel(x)[0].astype(jnp.float32))
+            break
+    return tree

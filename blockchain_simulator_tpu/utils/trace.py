@@ -25,6 +25,7 @@ import numpy as np
 from blockchain_simulator_tpu.models.base import get_protocol
 from blockchain_simulator_tpu.utils import prng
 from blockchain_simulator_tpu.utils.config import SimConfig
+from blockchain_simulator_tpu.utils.sync import force_sync
 
 
 def probe(cfg: SimConfig, state) -> dict:
@@ -107,11 +108,13 @@ def profile_run(cfg: SimConfig, logdir: str, seed: int | None = None) -> dict:
     sim = make_sim_fn(cfg)
     key = jax.random.key(cfg.seed if seed is None else seed)
     t0 = time.perf_counter()
-    jax.block_until_ready(sim(key))  # compile + warm outside the capture
+    # force_sync throughout: block_until_ready alone measures dispatch, not
+    # execution, on this env's axon backend (KNOWN_ISSUES.md #1)
+    force_sync(sim(key))  # compile + warm outside the capture
     compile_s = time.perf_counter() - t0
     with jax.profiler.trace(logdir):
         t0 = time.perf_counter()
-        final = jax.block_until_ready(sim(key))
+        final = force_sync(sim(key))
         run_s = time.perf_counter() - t0
     m = proto.metrics(cfg, final)
     m["compile_plus_first_run_s"] = compile_s

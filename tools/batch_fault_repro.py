@@ -1,0 +1,117 @@
+"""Minimal-repro bisection for the batch>=2 vmap TPU device fault.
+
+Round 2 observed: the 100k-node PBFT bench completes on the TPU with batch=1
+but faults the chip ("TPU device error - kernel fault") when the simulation is
+vmapped over a batch of >= 2 seeds.  This script shrinks the failing program
+along each axis (batch, N, ticks, window, channels) to find the smallest
+configuration that still faults, so the failure can be attributed to a
+specific op pattern rather than "the whole simulation".
+
+Each trial runs in a subprocess (a faulted chip can poison the process); the
+parent records PASS/FAIL per config and prints a summary table.
+
+Usage: python tools/batch_fault_repro.py            # run the bisection
+       python tools/batch_fault_repro.py --trial '{"batch":2,...}'  # one trial
+"""
+
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def trial(spec: dict) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from blockchain_simulator_tpu.runner import make_sim_fn
+    from blockchain_simulator_tpu.utils.config import SimConfig
+    from blockchain_simulator_tpu.utils.sync import force_sync
+
+    batch = spec["batch"]
+    cfg = SimConfig(
+        protocol="pbft",
+        n=spec["n"],
+        sim_ms=spec["ticks"],
+        pbft_max_rounds=40,
+        pbft_max_slots=48,
+        pbft_window=spec.get("window", 8),
+        delivery="stat",
+    )
+    sim = make_sim_fn(cfg)
+    if batch > 1:
+        run = jax.jit(jax.vmap(sim))
+        keys = jax.vmap(jax.random.key)(jnp.arange(batch, dtype=jnp.uint32))
+    else:
+        run = sim
+        keys = jax.random.key(0)
+    force_sync(run(keys))
+    print(json.dumps({"ok": True, "backend": jax.default_backend()}))
+
+
+def run_trial(spec: dict, timeout_s: float = 240.0) -> str:
+    env = dict(os.environ)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--trial", json.dumps(spec)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+        start_new_session=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        import signal
+
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        proc.communicate(timeout=10)
+        return "HANG"
+    if proc.returncode == 0 and '"ok": true' in out:
+        return "PASS"
+    tail = err.strip().splitlines()[-1] if err.strip() else "?"
+    return f"FAIL({tail[:120]})"
+
+
+def main() -> None:
+    results = []
+
+    def record(spec, timeout_s=240.0):
+        t0 = time.time()
+        r = run_trial(spec, timeout_s)
+        results.append((spec, r, round(time.time() - t0, 1)))
+        print(json.dumps({"spec": spec, "result": r, "wall_s": results[-1][2]}),
+              flush=True)
+        return r
+
+    # 1. reproduce at headline scale, then shrink N while batch=2 still fails
+    base = {"batch": 2, "ticks": 200, "window": 8}
+    for n in (100_000, 10_000, 1_000, 64):
+        r = record({**base, "n": n})
+        if r == "PASS":
+            break
+    # 2. control: batch=1 at the largest size
+    record({"batch": 1, "n": 100_000, "ticks": 200, "window": 8})
+    # 3. does exact-window mode change it?
+    record({"batch": 2, "n": 100_000, "ticks": 200, "window": 0})
+    print("\nsummary:")
+    for spec, r, w in results:
+        print(f"  {r:40s} {w:7.1f}s  {json.dumps(spec)}")
+
+
+if __name__ == "__main__":
+    if "--trial" in sys.argv:
+        trial(json.loads(sys.argv[sys.argv.index("--trial") + 1]))
+    else:
+        main()

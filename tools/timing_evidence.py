@@ -1,0 +1,71 @@
+"""Evidence script for KNOWN_ISSUES.md: phantom block_until_ready timing.
+
+Runs the 100k-node PBFT simulation at several tick counts and reports, for
+each, the wall time measured two ways:
+
+- ``bur_s``   — stop the clock after ``jax.block_until_ready`` (the round-2
+  methodology; untrustworthy on this backend).
+- ``sync_s``  — stop the clock after :func:`utils.sync.force_sync` (scalar
+  readback of every result leaf; trustworthy).
+
+If the backend honors block_until_ready the two columns agree; on the axon
+tunnel backend bur_s stays flat in the tick count while sync_s scales
+linearly — the smoking gun recorded in KNOWN_ISSUES.md.
+
+Usage:  python tools/timing_evidence.py [N]        (default N=100000)
+"""
+
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import json
+import sys
+import time
+
+import jax
+
+from blockchain_simulator_tpu.runner import make_sim_fn
+from blockchain_simulator_tpu.utils.config import SimConfig
+from blockchain_simulator_tpu.utils.sync import force_sync
+
+
+def measure(cfg: SimConfig) -> dict:
+    sim = make_sim_fn(cfg)
+    key = jax.random.key(7)
+    force_sync(sim(key))  # compile + warm
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(sim(jax.random.key(8)))
+    bur_s = time.perf_counter() - t0
+    force_sync(out)
+    sync_s = time.perf_counter() - t0
+    return {
+        "n": cfg.n,
+        "ticks": cfg.ticks,
+        "bur_s": round(bur_s, 4),
+        "sync_s": round(sync_s, 4),
+        "sync_us_per_tick": round(sync_s / cfg.ticks * 1e6, 1),
+    }
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    print(json.dumps({"backend": jax.default_backend()}))
+    for ticks in (525, 1050, 2100, 4200):
+        cfg = SimConfig(
+            protocol="pbft",
+            n=n,
+            sim_ms=ticks,
+            pbft_max_rounds=40,
+            pbft_max_slots=48,
+            pbft_window=8,
+            delivery="stat",
+        )
+        print(json.dumps(measure(cfg)))
+
+
+if __name__ == "__main__":
+    main()

@@ -44,6 +44,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fidelity", choices=["reference", "clean"],
                    default=d.fidelity)
     p.add_argument("--delivery", choices=["edge", "stat"], default=d.delivery)
+    p.add_argument("--schedule", choices=["tick", "round", "auto"],
+                   default=d.schedule,
+                   help="tick = general 1ms-tick engine; round = PBFT "
+                        "round-blocked fast path (validated); auto = round "
+                        "when eligible and n >= 4096")
     p.add_argument("--stat-sampler", choices=["exact", "normal", "auto"],
                    default=d.stat_sampler,
                    help="binomial sampler for stat-delivery bucket counts: "
@@ -55,6 +60,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--shards", type=int, default=0,
                    help="shard node state over this many devices (jax engine)")
     p.add_argument("--link-delay-ms", type=int, default=d.link_delay_ms)
+    p.add_argument("--serialization", choices=["on", "off"],
+                   default="on" if d.model_serialization else "off",
+                   help="model per-message block serialization time "
+                        "(bytes*8/link_rate; the reference's dominant "
+                        "timing term) in addition to propagation delay")
     # topology (BASELINE config 3: gossip instead of full mesh)
     p.add_argument("--topology", choices=["full", "kregular"], default=d.topology)
     p.add_argument("--degree", type=int, default=d.degree,
@@ -84,6 +94,12 @@ def build_parser() -> argparse.ArgumentParser:
     # per-protocol knobs (reference values as defaults)
     p.add_argument("--pbft-interval-ms", type=int, default=d.pbft_block_interval_ms)
     p.add_argument("--pbft-rounds", type=int, default=d.pbft_max_rounds)
+    p.add_argument("--pbft-max-slots", type=int, default=d.pbft_max_slots,
+                   help="vote-table slots; rounds are capped at "
+                        "min(pbft_rounds, pbft_max_slots)")
+    p.add_argument("--pbft-window", type=int, default=d.pbft_window,
+                   help="live vote-state window W (0 = exact full table); "
+                        "the O(N*W) memory lever at 100k nodes")
     p.add_argument("--raft-heartbeat-ms", type=int, default=d.raft_heartbeat_ms)
     p.add_argument("--raft-blocks", type=int, default=d.raft_max_blocks)
     p.add_argument("--paxos-proposers", type=int, default=d.paxos_n_proposers)
@@ -91,6 +107,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="raft shard count for --protocol mixed")
     p.add_argument("--timing", action="store_true",
                    help="include wallclock timing in the output")
+    # observability (utils/trace.py; the reference's NS_LOG surface as data)
+    p.add_argument("--trace", metavar="FILE.npz",
+                   help="record per-tick probe series (committed blocks, "
+                        "views, elections, ...) to an .npz next to the "
+                        "metrics line")
+    p.add_argument("--profile", metavar="LOGDIR",
+                   help="capture a jax.profiler trace of the (pre-compiled) "
+                        "run into LOGDIR (view with TensorBoard/perfetto)")
     return p
 
 
@@ -103,14 +127,18 @@ def config_from_args(args) -> SimConfig:
         fidelity=args.fidelity,
         delivery=args.delivery,
         stat_sampler=args.stat_sampler,
+        schedule=args.schedule,
         quorum_rule=args.quorum_rule,
         link_delay_ms=args.link_delay_ms,
+        model_serialization=args.serialization == "on",
         topology=args.topology,
         degree=args.degree,
         gossip_hops=args.gossip_hops,
         paxos_retry_timeout_ms=args.paxos_timeout_ms,
         pbft_block_interval_ms=args.pbft_interval_ms,
         pbft_max_rounds=args.pbft_rounds,
+        pbft_max_slots=args.pbft_max_slots,
+        pbft_window=args.pbft_window,
         raft_heartbeat_ms=args.raft_heartbeat_ms,
         raft_max_blocks=args.raft_blocks,
         paxos_n_proposers=args.paxos_proposers,
@@ -148,6 +176,10 @@ def main(argv=None) -> int:
             print("error: --byz-sweep requires the jax engine",
                   file=sys.stderr)
             return 2
+        if args.trace or args.profile:
+            print("error: --trace/--profile require the jax engine",
+                  file=sys.stderr)
+            return 2
         import time
 
         from blockchain_simulator_tpu.engine import run_cpp
@@ -169,6 +201,26 @@ def main(argv=None) -> int:
 
         for row in run_byzantine_sweep(cfg, seeds=seeds):
             print(json.dumps(row))
+        return 0
+
+    if args.trace or args.profile:
+        if args.shards > 1 or len(seeds) > 1:
+            print("error: --trace/--profile apply to single-seed unsharded "
+                  "jax runs", file=sys.stderr)
+            return 2
+        from blockchain_simulator_tpu.utils import trace as trace_mod
+
+        if args.trace:
+            import numpy as _np
+
+            m, series = trace_mod.run_traced(cfg, seed=seeds[0])
+            _np.savez(args.trace, **series)
+            m["trace_file"] = args.trace
+            m["trace_series"] = sorted(series)
+        else:
+            m = trace_mod.profile_run(cfg, args.profile, seed=seeds[0])
+            m["profile_dir"] = args.profile
+        print(json.dumps(m))
         return 0
 
     if args.timing and (args.shards > 1 or len(seeds) > 1):

@@ -53,6 +53,8 @@ class _CppCfg(ctypes.Structure):
         ("n_crashed", ctypes.c_int32),
         ("n_byzantine", ctypes.c_int32),
         ("drop_prob", ctypes.c_double),
+        ("ser_pbft", ctypes.c_int32),
+        ("ser_raft", ctypes.c_int32),
     ]
 
 
@@ -158,6 +160,8 @@ def cpp_config(cfg, seed: int | None = None) -> _CppCfg:
         n_crashed=cfg.faults.resolved_n_crashed(cfg.n),
         n_byzantine=cfg.faults.n_byzantine,
         drop_prob=cfg.faults.drop_prob,
+        ser_pbft=cfg.serialization_ticks(cfg.pbft_block_bytes),
+        ser_raft=cfg.serialization_ticks(cfg.raft_block_bytes),
     )
 
 

@@ -75,6 +75,14 @@ struct SimCfg {
   int32_t n_crashed;
   int32_t n_byzantine;
   double drop_prob;
+  // serialization delay (ticks) added to block-carrying messages: the
+  // reference's 3 Mbps links take ~136 ms to serialize a 50 KB PBFT block
+  // (blockchain-simulator.cc:22-24, pbft-node.cc:377-380) and ~54 ms for a
+  // 20 KB Raft proposal (raft-node.cc:409).  Links are NOT queued: the
+  // serialization term is a constant latency per message, matching the JAX
+  // engines (see SimConfig.model_serialization).
+  int32_t ser_pbft;
+  int32_t ser_raft;
 };
 
 // ---------------------------------------------------------------------------
@@ -144,18 +152,19 @@ class Sim {
   }
   // unicast with a fresh delay draw + drop roll (the reference defers every
   // send via Simulator::Schedule(getRandomDelay(), ...), SURVEY.md C8)
-  void send(int32_t to, const Msg& m) {
+  void send(int32_t to, const Msg& m, int32_t extra = 0) {
     if (dropped()) return;
-    schedule_msg(to, m, delay());
+    schedule_msg(to, m, delay() + extra);
   }
   // broadcast to all peers except self (and optionally except the sender's
   // first peer — the Paxos iterator bug, paxos-node.cc:478-496)
-  void bcast(int32_t from, const Msg& m, bool skip_first_peer = false) {
+  void bcast(int32_t from, const Msg& m, bool skip_first_peer = false,
+             int32_t extra = 0) {
     int32_t first = (from == 0) ? 1 : 0;
     for (int32_t to = 0; to < cfg.n; ++to) {
       if (to == from) continue;
       if (skip_first_peer && to == first) continue;
-      send(to, m);
+      send(to, m, extra);
     }
   }
 };
@@ -177,8 +186,13 @@ struct Node : NodeBase {
 struct Engine {
   Sim sim;
   std::vector<Node> nodes;
+  // first actual broadcast tick per slot (models/pbft.py slot_propose_tick):
+  // with a view change + in-flight serialization, a new leader re-proposes
+  // stale slots, so slot s is NOT proposed at (s+1)*interval in general
+  std::vector<int32_t> propose_tick;
   explicit Engine(const SimCfg& c) : sim(c) {
     int32_t s = c.pbft_slots;
+    propose_tick.assign(s, -1);
     nodes.resize(c.n);
     for (int32_t i = 0; i < c.n; ++i) {
       Node& nd = nodes[i];
@@ -202,7 +216,9 @@ struct Engine {
     // SendBlock (pbft-node.cc:372-411)
     if (nd.id == nd.leader && nd.next_n < std::min(c.pbft_max_rounds, c.pbft_slots)) {
       Msg m{PRE_PREPARE, nd.id, nd.v, nd.next_n, nd.next_n};  // val == n
-      sim.bcast(nd.id, m);
+      sim.bcast(nd.id, m, false, c.ser_pbft);  // 50 KB block serialization
+      if (nd.next_n < c.pbft_slots && propose_tick[nd.next_n] < 0)
+        propose_tick[nd.next_n] = static_cast<int32_t>(sim.now);
       nd.rounds_sent++;
       nd.next_n++;
       // random view change, P = num/den per leader round (pbft-node.cc:401-403)
@@ -318,7 +334,9 @@ struct Engine {
   void send_heartbeat(Node& nd) {  // sendHeartBeat (raft-node.cc:405-433)
     const SimCfg& c = sim.cfg;
     if (nd.add_change_value) {
-      sim.bcast(nd.id, Msg{HEARTBEAT, nd.id, HB_PROPOSAL, nd.id, 0});
+      // 20 KB proposal block serialization (raft-node.cc:409)
+      sim.bcast(nd.id, Msg{HEARTBEAT, nd.id, HB_PROPOSAL, nd.id, 0}, false,
+                c.ser_raft);
       nd.round++;  // SendTX round++ (raft-node.cc:360-365)
       if (nd.round >= c.raft_max_rounds) nd.add_change_value = false;
       if (c.fidelity == 1) {
@@ -699,9 +717,9 @@ std::string json_pbft(pbft::Engine& eng) {
         all = all && nd.committed[s];
         mx = std::max(mx, nd.commit_tick[s]);
       }
-    if (all) {
+    if (all && eng.propose_tick[s] >= 0) {
       final_all++;
-      ttf_sum += mx - (s + 1) * c.pbft_interval;
+      ttf_sum += mx - eng.propose_tick[s];
       last = std::max(last, mx);
     }
   }

@@ -18,9 +18,10 @@ shard; a shard whose leader crashes drops out of the PBFT quorum until
 re-election (clean fidelity re-arms election timers, so representation
 recovers).
 
-Scope note: single-program execution (one chip or one vmapped program); the
-shard axis is embarrassingly parallel so the sweep machinery batches it, but
-``parallel.shard`` row-sharding of the mixed state is not wired up yet.
+Scale-out: the shard axis is embarrassingly parallel; ``parallel.shard``
+row-shards the raft leaves over the mesh's ``nodes`` axis (the S-node PBFT
+layer is replicated per device — it is O(S) tiny), which is how BASELINE
+config 5's 256 shards x 1k nodes = 256k simulated nodes run on one mesh.
 """
 
 from __future__ import annotations
@@ -78,10 +79,18 @@ def init(cfg, key=None):
 
 
 def step(cfg, state: MixedState, bufs: MixedBufs, t, tkey):
-    s = cfg.mixed_shards
+    """One tick.  Sharded (cfg.mesh_axis set): raft shards are row-sharded
+    over the mesh axis (embarrassingly parallel — per-shard PRNG streams key
+    on the GLOBAL shard id), while the S-representative PBFT instance is
+    replicated on every device: its inputs (the [S] has-leader mask) are
+    all-gathered, so each device steps an identical copy with identical keys
+    and the replicated state never diverges."""
+    axis = cfg.mesh_axis
     rcfg, pcfg = sub_configs(cfg)
-    shard_keys = jax.vmap(lambda i: jax.random.fold_in(tkey, 0x0C0C + i))(
-        jnp.arange(s)
+    s_loc = state.raft.block_num.shape[0]  # local shard rows
+    base = 0 if axis is None else jax.lax.axis_index(axis) * s_loc
+    shard_keys = jax.vmap(lambda i: jax.random.fold_in(tkey, 0x0C0C + base + i))(
+        jnp.arange(s_loc)
     )
     r_state, r_bufs = jax.vmap(
         functools.partial(raft.step, rcfg, t=t)
@@ -89,6 +98,8 @@ def step(cfg, state: MixedState, bufs: MixedBufs, t, tkey):
     # cross-shard membership: a representative is alive iff its shard
     # currently has an elected, alive leader
     has_leader = (r_state.is_leader & r_state.alive).any(axis=1)
+    if axis is not None:
+        has_leader = jax.lax.all_gather(has_leader, axis, tiled=True)
     p_state = state.pbft.replace(alive=has_leader)
     p_state, p_bufs = pbft.step(
         pcfg, p_state, bufs.pbft, t, jax.random.fold_in(tkey, 0x9B9B)

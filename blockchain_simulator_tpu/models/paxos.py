@@ -218,32 +218,9 @@ def _req_contrib(key, val_local, lo, hi, drop, axis, ids, p, ref_skip):
 
 
 def _gossip_fwd_contrib(key, fwd_vals, nbrs_loc, n_glob, lo, hi, drop, axis):
-    """Gossip forwarding: TTL-encoded values held by local rows → [B, N_loc, P]
-    scatter-max contributions at their out-neighbors (global ids), one fresh
-    delay draw per (sender, edge, proposer).  Sharded: scatter into the global
-    row space, pmax across shards (each shard contributes its senders'
-    forwards), slice the local rows back out."""
-    n_loc, p = fwd_vals.shape
-    deg = nbrs_loc.shape[1]
-    k = dv._shard_key(key, axis)
-    d = delay_ops.sample_edge_delays(k, (n_loc, deg, p), lo, hi)
-    vals = jnp.broadcast_to(fwd_vals[:, None, :], (n_loc, deg, p))
-    if drop > 0.0:
-        keep = jax.random.bernoulli(
-            jax.random.fold_in(k, 0x0D22), 1.0 - drop, (n_loc, deg, p)
-        )
-        vals = vals * keep
-    # one scatter-max over a flattened (bucket, receiver) index — XLA handles
-    # a single big scatter far better than hi-lo separate ones
-    flat_idx = (d - lo) * n_glob + nbrs_loc[:, :, None]  # [n_loc, deg, p]
-    flat = jnp.zeros(((hi - lo) * n_glob, p), jnp.int32)
-    flat = flat.at[flat_idx, jnp.arange(p)[None, None, :]].max(vals)
-    out = flat.reshape(hi - lo, n_glob, p)
-    if axis is not None:
-        out = jax.lax.pmax(out, axis)
-        start = jax.lax.axis_index(axis) * n_loc
-        out = jax.lax.dynamic_slice_in_dim(out, start, n_loc, axis=1)
-    return out
+    """TTL-flood forwarding for the three request channels — shared op
+    (ops/delivery.gossip_fwd), P = proposer lanes here."""
+    return dv.gossip_fwd(key, fwd_vals, nbrs_loc, n_glob, lo, hi, drop, axis)
 
 
 def _reply_contribs(key, ok_wire, no_wire, cmd_wire, lo, hi, drop, axis, ids, p):

@@ -59,6 +59,7 @@ from flax import struct
 from blockchain_simulator_tpu.models.base import fault_masks, gated
 from blockchain_simulator_tpu.ops import delay as delay_ops
 from blockchain_simulator_tpu.ops import delivery as dv
+from blockchain_simulator_tpu.ops import topology
 from blockchain_simulator_tpu.ops.ring import ring_pop, ring_push_add, ring_push_max
 from blockchain_simulator_tpu.utils.prng import Channel, chan_key
 
@@ -87,6 +88,9 @@ class PbftState:
     view_changes: jax.Array  # [N] view changes initiated
     alive: jax.Array         # [N] bool fault mask
     honest: jax.Array        # [N] bool fault mask
+    # gossip (topology="kregular") dedup state; zeros on the full mesh
+    seen_pp: jax.Array       # [N, W] highest TTL-encoded PRE_PREPARE seen
+    seen_vc: jax.Array       # [N] highest TTL-encoded VIEW_CHANGE seen
     # --- per-slot accumulators (GLOBAL_FIELDS; per-shard partials) ----------
     slot_commits: jax.Array      # [S] nodes that finalized slot s (first time)
     slot_commit_tick: jax.Array  # [S] last finalization tick, -1 never
@@ -112,6 +116,13 @@ def init(cfg, key=None):
     n, s = cfg.n, cfg.pbft_max_slots
     w = eff_window(cfg)
     d = cfg.ring_depth
+    if cfg.topology == "kregular" and w < s:
+        raise ValueError(
+            "pbft gossip (topology='kregular') requires exact vote-table mode "
+            "(pbft_window = 0 or >= pbft_max_slots): a multi-hop PRE_PREPARE "
+            "can trail its slot's direct-unicast COMMIT votes, which exact "
+            "mode attributes by window identity while a window would misfile"
+        )
     if w < s:
         lo, hi = cfg.one_way_range()
         if 4 * lo <= hi:
@@ -149,6 +160,8 @@ def init(cfg, key=None):
         view_changes=zi(n),
         alive=alive,
         honest=honest,
+        seen_pp=zi(n, w),
+        seen_vc=zi(n),
         slot_commits=zi(s),
         slot_commit_tick=jnp.full((s,), -1, jnp.int32),
         slot_propose_tick=jnp.full((s,), _NEVER, jnp.int32),
@@ -216,6 +229,41 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     pp_t, prep_t, com_t = pp_t * am[:, None], prep_t * am[:, None], com_t * am[:, None]
     vc_t = vc_t * am
 
+    # ---- gossip decode (topology="kregular"): the block-carrying channels
+    # (PRE_PREPARE) and the control channel (VIEW_CHANGE) flood over the k-out
+    # digraph with a hop TTL; votes stay direct unicast — they are 4-byte
+    # packets, and flooding them would need per-sender dedup state (O(N^2)),
+    # defeating the sparse path.  Channel values carry encoded*H + hops_left
+    # (H = gossip_hops+1); a node processes each base value once (first
+    # sighting) but forwards any strictly better TTL copy, so a nearly-expired
+    # first arrival cannot truncate the flood (same scheme as models/paxos.py).
+    gossip = cfg.topology == "kregular"
+    seen_pp, seen_vc = state.seen_pp, state.seen_vc
+    pp_fwd = vc_fwd = None
+    nbrs_loc = None
+    if gossip:
+        h_enc = cfg.gossip_hops + 1
+        nbrs_loc = jnp.take(
+            jnp.asarray(topology.kregular_out_neighbors(n, cfg.degree, cfg.seed)),
+            ids, axis=0,
+        )
+        pp_base, pp_hops = pp_t // h_enc, pp_t % h_enc
+        better = (pp_t > seen_pp) & state.alive[:, None]
+        new_base = (pp_base > seen_pp // h_enc) & state.alive[:, None]
+        seen_pp = jnp.maximum(seen_pp, pp_t * better)
+        pp_fwd = (pp_base * h_enc + jnp.maximum(pp_hops - 1, 0)) * (
+            better & (pp_hops > 0)
+        )
+        pp_t = pp_base * new_base  # first sighting processes (value = slot+1)
+        vc_base, vc_hops = vc_t // h_enc, vc_t % h_enc
+        vbetter = (vc_t > seen_vc) & state.alive
+        vnew = (vc_base > seen_vc // h_enc) & state.alive
+        seen_vc = jnp.maximum(seen_vc, vc_t * vbetter)
+        vc_fwd = (vc_base * h_enc + jnp.maximum(vc_hops - 1, 0)) * (
+            vbetter & (vc_hops > 0)
+        )
+        vc_t = vc_base * vnew
+
     # ---- VIEW_CHANGE arrivals: adopt (v, leader) (pbft-node.cc:271-280) -----
     has_vc = vc_t > 0
     v = jnp.where(has_vc, (vc_t - 1) // n, state.v)
@@ -227,10 +275,20 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     arr_sid = pp_t - 1  # announced slot id
     new_tenant = got_pp & (arr_sid > state.slot_id)
     slot_id = jnp.where(new_tenant, arr_sid, state.slot_id)
-    prepare_vote = jnp.where(new_tenant, 0, state.prepare_vote)
-    commit_vote = jnp.where(new_tenant, 0, state.commit_vote)
-    prep_sent = state.prep_sent & ~new_tenant
-    committed_w = state.committed_w & ~new_tenant
+    if exact:
+        # windows ARE slot identities — nothing is ever re-tenanted, so a
+        # learned tenant must not wipe the counters: votes can legitimately
+        # precede the PRE_PREPARE (gossip: direct-unicast COMMITs outrun the
+        # multi-hop block flood; drops: the pp may never come at all) and
+        # were already attributed to this window by identity
+        prepare_vote, commit_vote = state.prepare_vote, state.commit_vote
+        prep_sent, committed_w = state.prep_sent, state.committed_w
+    else:
+        # windowed mode: a higher slot id evicts the stale tenant's state
+        prepare_vote = jnp.where(new_tenant, 0, state.prepare_vote)
+        commit_vote = jnp.where(new_tenant, 0, state.commit_vote)
+        prep_sent = state.prep_sent & ~new_tenant
+        committed_w = state.committed_w & ~new_tenant
     seen_hi = jnp.max(jnp.where(got_pp, arr_sid + 1, 0), axis=1)
     next_n = jnp.maximum(state.next_n, seen_hi)
 
@@ -341,16 +399,38 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     )
     own_w = next_n % w
     own_onehot = (windows[None, :] == own_w[:, None]) & send_block[:, None]
-    # the proposer evicts its own window (it never hears its own PRE_PREPARE)
+    # the proposer learns its own window's tenant (it never hears its own
+    # PRE_PREPARE); in exact mode the counters survive for the same reason
+    # as at pp arrival above (identity windows — e.g. a post-view-change
+    # leader re-proposing an in-flight slot must not discard its votes)
     slot_id = jnp.where(own_onehot, next_n[:, None], slot_id)
-    prepare_vote = jnp.where(own_onehot, 0, prepare_vote)
-    commit_vote = jnp.where(own_onehot, 0, commit_vote)
-    prep_sent = prep_sent & ~own_onehot
-    committed_w = committed_w & ~own_onehot
+    if not exact:
+        prepare_vote = jnp.where(own_onehot, 0, prepare_vote)
+        commit_vote = jnp.where(own_onehot, 0, commit_vote)
+        prep_sent = prep_sent & ~own_onehot
+        committed_w = committed_w & ~own_onehot
     pp_val = own_onehot.astype(jnp.int32) * (next_n[:, None] + 1)
     ser = cfg.serialization_ticks(cfg.pbft_block_bytes)
     k_pp = chan_key(tkey, Channel.DELAY_BCAST2)
-    if stat:
+    if gossip:
+        # origin injection (TTL = gossip_hops) + this tick's relays, one
+        # flood push over the out-edges; every hop re-serializes the block
+        # (store-and-forward), hence the ser term on each leg
+        h_enc = cfg.gossip_hops + 1
+        origin_enc = (pp_val * h_enc + cfg.gossip_hops) * (pp_val > 0)
+        # the proposer must never process its own announcement (the reference
+        # leader never hears its own PRE_PREPARE); self-loop edges exist in
+        # the random digraph, so mark the origin's copy as already seen
+        seen_pp = jnp.maximum(seen_pp, origin_enc)
+        pp_out = jnp.maximum(origin_enc, pp_fwd)
+        pp_contrib = gated(
+            (pp_out > 0).any(),
+            lambda: dv.gossip_fwd(k_pp, pp_out, nbrs_loc, n, lo, hi, drop,
+                                  axis=axis),
+            zeros_w,
+            axis,
+        )
+    elif stat:
         pp_contrib = gated(
             send_block.any(),
             lambda: dv.bcast_window_value_max_stat(k_pp, pp_val, ow_probs, drop,
@@ -388,7 +468,19 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     enc = jnp.where(trigger, new_v * n + new_leader + 1, 0)
     k_vc = chan_key(tkey, Channel.DELAY_REPLY)
     zeros_flat = jnp.zeros((hi - lo, n_loc), jnp.int32)
-    if stat:
+    if gossip:
+        h_enc = cfg.gossip_hops + 1
+        vc_origin = (enc * h_enc + cfg.gossip_hops) * (enc > 0)
+        seen_vc = jnp.maximum(seen_vc, vc_origin)  # self-loop guard
+        vc_out = jnp.maximum(vc_origin, vc_fwd)
+        vc_contrib = gated(
+            (vc_out > 0).any(),
+            lambda: dv.gossip_fwd(k_vc, vc_out[:, None], nbrs_loc, n, lo, hi,
+                                  drop, axis=axis)[:, :, 0],
+            zeros_flat,
+            axis,
+        )
+    elif stat:
         vc_contrib = gated(
             trigger.any(),
             lambda: dv.bcast_value_max_stat(k_vc, enc, ow_probs, drop, axis=axis),
@@ -405,6 +497,8 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     vc = ring_push_max(vc, t, lo, vc_contrib)
 
     state = state.replace(
+        seen_pp=seen_pp,
+        seen_vc=seen_vc,
         v=v,
         leader=leader,
         next_n=next_n,

@@ -1,0 +1,308 @@
+"""PBFT round-blocked fast path: one scan step = one 50 ms consensus round.
+
+The general engine (models/pbft.py) advances 1 ms ticks, carrying [N, W] vote
+state and [D, N, W] future-inbox rings.  That is the faithful, fully general
+machine — but at N = 100k the compiled tick body rewrites each 57 MB ring
+buffer several times per tick (round-3 HLO analysis: 13 full-buffer fusions,
+~1.5 GB of HBM traffic per 1 ms tick), capping throughput near 8 simulated
+rounds/s on a v5e chip.
+
+This module exploits the protocol's structure instead (the TPU-first answer
+to SURVEY.md §7 "hard parts" #2, multi-rate stepping, taken to its limit):
+when no messages cross a round boundary, a whole PBFT round is a *closed*
+static wave — propose at t0; PRE_PREPAREs land at t0+U{lo..hi-1}; each
+receiver's PREPARE round-trip replies arrive as multinomial bucket counts
+over the triangular two-leg distribution; vote counters cross thresholds by
+a short cumulative loop over those buckets; COMMIT broadcasts group by send
+tick and land as per-receiver multinomial counts again.  Everything is a
+handful of ops on [N] vectors: no vote table, no rings, ~50 ticks of
+simulation per scan step for less memory traffic than ONE tick of the
+general engine.
+
+Semantics match models/pbft.step for every configuration this path accepts
+(`eligible` below): identical timer/threshold/fidelity logic, identical
+view-change draw (same PRNG channel at the block tick), same metrics
+surface; delivery randomness is drawn per round instead of per tick, so
+results are distributionally — not bit — identical to the tick engine
+(delivery="stat" is already an aggregate model; tests pin milestone
+equality and distribution closeness).
+
+Eligibility (checked statically from the config):
+- protocol "pbft", topology "full", delivery "stat";
+- no per-message drops (with drops, leader belief can diverge between nodes
+  and rounds stop being single-proposer);
+- no byz_forge flood (targets the exact-window tick machine);
+- no serialization delay, and the message horizon must fit inside one block
+  interval (max arrival offset < pbft_block_interval_ms), so rounds close.
+
+Reference anchors: the round cadence being reproduced is SendBlock's 50 ms
+self-rescheduling loop (pbft-node.cc:372-411); thresholds pbft-node.cc:231,
+248; view change pbft-node.cc:294-303,401-403; finality log pbft-node.cc:259.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from blockchain_simulator_tpu.models import pbft as pbft_tick
+from blockchain_simulator_tpu.models.base import fault_masks
+from blockchain_simulator_tpu.ops import delay as delay_ops
+from blockchain_simulator_tpu.ops.delivery import _global_ids, _shard_key
+from blockchain_simulator_tpu.utils.prng import Channel, chan_key
+
+_NEVER = pbft_tick._NEVER
+
+GLOBAL_FIELDS = pbft_tick.GLOBAL_FIELDS
+
+
+@struct.dataclass
+class PbftRoundState:
+    """Cross-round state only — all in-round vote bookkeeping is transient.
+
+    Field names/meanings mirror models/pbft.PbftState so pbft.metrics() reads
+    either; the [N, W] table fields simply do not exist here.
+    """
+
+    v: jax.Array             # [N]
+    leader: jax.Array        # [N]
+    next_n: jax.Array        # [N]
+    rounds_sent: jax.Array   # [N]
+    block_num: jax.Array     # [N]
+    unattributed: jax.Array  # [N] (always 0 on this path: no drops)
+    view_changes: jax.Array  # [N]
+    alive: jax.Array         # [N]
+    honest: jax.Array        # [N]
+    slot_commits: jax.Array      # [S]
+    slot_commit_tick: jax.Array  # [S]
+    slot_propose_tick: jax.Array  # [S]
+
+
+def max_arrival_offset(cfg) -> int:
+    """Latest in-round event offset: commit sent at (hi-1)+rt_hi-1 arriving
+    +hi-1 later."""
+    lo, hi = cfg.one_way_range()
+    rt_lo, rt_hi = cfg.roundtrip_range()
+    return (hi - 1) + (rt_hi - 1) + (hi - 1)
+
+
+def eligible(cfg) -> bool:
+    return (
+        cfg.protocol == "pbft"
+        and cfg.topology == "full"
+        and cfg.delivery == "stat"
+        and cfg.faults.drop_prob == 0.0
+        and not cfg.faults.byz_forge
+        and cfg.serialization_ticks(cfg.pbft_block_bytes) == 0
+        and max_arrival_offset(cfg) < cfg.pbft_block_interval_ms
+    )
+
+
+def init(cfg, key=None):
+    n, s = cfg.n, cfg.pbft_max_slots
+    alive, honest = fault_masks(cfg, n)
+    zi = lambda *sh: jnp.zeros(sh, jnp.int32)
+    state = PbftRoundState(
+        v=jnp.ones((n,), jnp.int32),
+        leader=zi(n),
+        next_n=zi(n),
+        rounds_sent=zi(n),
+        block_num=zi(n),
+        unattributed=zi(n),
+        view_changes=zi(n),
+        alive=alive,
+        honest=honest,
+        slot_commits=zi(s),
+        slot_commit_tick=jnp.full((s,), -1, jnp.int32),
+        slot_propose_tick=jnp.full((s,), _NEVER, jnp.int32),
+    )
+    return state, ()
+
+
+finalize = pbft_tick.finalize  # same GLOBAL_FIELDS partial-combining
+
+
+def _psum(x, axis):
+    return x if axis is None else jax.lax.psum(x, axis)
+
+
+def _pmax(x, axis):
+    return x if axis is None else jax.lax.pmax(x, axis)
+
+
+def _crossing_loop(buckets, need, clean: bool, start=None):
+    """Threshold crossings of a vote counter fed bucket-by-bucket.
+
+    ``buckets``: [B, N] arrival counts in tick order.  Replicates the tick
+    engine's per-tick rule (pbft.step / pbft-node.cc:231,248): counter +=
+    arrivals; crossed iff arrivals > 0 and counter >= need; on crossing the
+    counter resets to 0 (reference fidelity; the whole batch is consumed) —
+    ``clean`` latches so only the first crossing fires.
+
+    Returns (crossed [B, N] bool, n_crossings [N], first_bucket [N] — index
+    of first crossing, B if none).
+    """
+    b, n = buckets.shape
+    cnt = jnp.zeros((n,), jnp.int32) if start is None else start
+    fired = jnp.zeros((n,), bool)
+    crossed_list = []
+    for k in range(b):
+        arr = buckets[k]
+        cnt = cnt + arr
+        crossed = (arr > 0) & (cnt >= need)
+        if clean:
+            crossed = crossed & ~fired
+        fired = fired | crossed
+        cnt = jnp.where(crossed, 0, cnt)
+        crossed_list.append(crossed)
+    crossed_mat = jnp.stack(crossed_list)  # [B, N]
+    n_cross = crossed_mat.astype(jnp.int32).sum(axis=0)
+    first = jnp.argmax(crossed_mat, axis=0)
+    first = jnp.where(crossed_mat.any(axis=0), first, b)
+    return crossed_mat, n_cross, first
+
+
+def step_round(cfg, state: PbftRoundState, r, key):
+    """Advance one whole block interval starting at t0 = r * interval.
+
+    Events are masked against the simulation window end (``cfg.ticks``): the
+    tick engine truncates a final round's message wave mid-flight (sends
+    happen at the block tick, but arrivals past the window never land), and
+    the masks reproduce exactly that."""
+    n, s = cfg.n, cfg.pbft_max_slots
+    axis = cfg.mesh_axis
+    bt = cfg.pbft_block_interval_ms
+    lo, hi = cfg.one_way_range()
+    rt_lo, rt_hi = cfg.roundtrip_range()
+    b1 = hi - lo
+    b2 = rt_hi - rt_lo
+    clean = cfg.fidelity == "clean"
+    smode = cfg.eff_stat_sampler
+    ow_probs = delay_ops.uniform_probs(lo, hi)
+    rt_probs = delay_ops.roundtrip_probs(lo, hi)
+    t0 = r * bt
+    n_loc = state.v.shape[0]
+    ids = _global_ids(n_loc, axis)
+    tkey = jax.random.fold_in(key, t0)
+
+    # ---- A. block tick: SendBlock + view-change draw (pbft.step "timers") ---
+    send = (
+        (state.leader == ids)
+        & (state.next_n < min(cfg.pbft_max_rounds, s))
+        & state.alive
+    )
+    slot_p1 = _pmax(jnp.max(jnp.where(send, state.next_n + 1, 0)), axis)  # 0=none
+    active = slot_p1 > 0
+    slot = slot_p1 - 1
+    rounds_sent = state.rounds_sent + send
+    next_n = jnp.where(send, state.next_n + 1, state.next_n)
+    # receivers learn the slot when the PRE_PREPARE lands (same round)
+    next_n = jnp.maximum(next_n, slot_p1)
+    slot_idx = jnp.where(active, slot, s)  # s = out-of-bounds drop
+    slot_propose_tick = state.slot_propose_tick.at[slot_idx].min(
+        jnp.where(active, jnp.int32(t0), _NEVER), mode="drop"
+    )
+
+    # view change: EXACTLY the tick engine's draw (same channel, same tick key)
+    k_u = chan_key(tkey, Channel.VIEW_CHANGE)
+    if axis is not None:
+        k_u = jax.random.fold_in(k_u, jax.lax.axis_index(axis))
+    u = jax.random.randint(k_u, (n_loc,), 0, cfg.pbft_view_change_den)
+    trigger = send & (u < cfg.pbft_view_change_num)
+    any_trigger = _pmax(jnp.max(trigger.astype(jnp.int32)), axis) > 0
+    new_leader = _pmax(jnp.max(jnp.where(trigger, (state.leader + 1) % n, 0)), axis)
+    view_changes = state.view_changes + trigger
+    # no drops: every node (sender immediately, receivers within the round)
+    # ends the round agreeing on (v+1, new_leader) — pbft-node.cc:271-280
+    v = jnp.where(any_trigger, state.v + 1, state.v)
+    leader = jnp.where(any_trigger, new_leader, state.leader)
+
+    # ---- B. PRE_PREPARE arrivals + PREPARE round trips ----------------------
+    # per-receiver arrival offset d_j ~ U{lo..hi-1}; proposer excluded
+    t_end = jnp.int32(cfg.ticks)  # arrivals at tick >= t_end never land
+    k_pp = chan_key(tkey, Channel.DELAY_BCAST2)
+    d_j = jax.random.randint(_shard_key(k_pp, axis), (n_loc,), lo, hi, jnp.int32)
+    recv = active & state.alive & ~send & (t0 + d_j < t_end)
+    # every receiver broadcasts PREPARE on arrival; honest alive peers reply
+    # SUCCESS (short-circuited round trip, pbft-node.cc:212-221)
+    voters = state.alive & state.honest
+    n_voters = _psum(voters.astype(jnp.int32).sum(), axis)
+    k_rt = chan_key(tkey, Channel.DELAY_ROUNDTRIP)
+    m_replies = jnp.where(recv, n_voters - voters.astype(jnp.int32), 0)
+    rt_counts = delay_ops.sample_bucket_counts(
+        _shard_key(k_rt, axis), m_replies, rt_probs, smode
+    )  # [B2, N] reply counts, bucket k -> tick t0 + d_j + rt_lo + k
+    rt_land = (t0 + d_j[None, :] + rt_lo + jnp.arange(b2)[:, None]) < t_end
+    rt_counts = rt_counts * rt_land.astype(jnp.int32)
+    crossed_p, _, _ = _crossing_loop(rt_counts, cfg.pbft_prepare_need, clean)
+    commit_send = crossed_p & (state.alive & state.honest)[None, :]  # [B2, N]
+
+    # ---- C. COMMIT waves -> finality ---------------------------------------
+    # sender j's k-th crossing happens at offset o = d_j + rt_lo + k; group
+    # send counts by absolute offset o in [lo+rt_lo, (hi-1)+rt_lo+B2-1]
+    w_send = b1 + b2 - 1  # distinct send offsets
+    off_base = lo + rt_lo
+    # one-hot of d_j over b1 (static small loop)
+    send_at = []  # per offset: [N] 0/1 this node sends a commit then
+    for o in range(w_send):
+        acc = jnp.zeros((n_loc,), jnp.int32)
+        for k in range(b2):
+            db = o - k  # d_j - lo == db
+            if 0 <= db < b1:
+                acc = acc + commit_send[k].astype(jnp.int32) * (d_j == lo + db)
+        send_at.append(acc)
+    send_at = jnp.stack(send_at)  # [w_send, N]
+    totals = _psum(send_at.sum(axis=1), axis)  # [w_send] global commit senders
+    # receiver m hears, per send offset o, totals[o] - own sends at o,
+    # spread multinomially over the one-way buckets
+    k_cm = chan_key(tkey, Channel.DELAY_BCAST)
+    w_arr = w_send + b1 - 1
+    arrivals = jnp.zeros((w_arr, n_loc), jnp.int32)
+    for o in range(w_send):
+        m_o = jnp.where(state.alive, totals[o] - send_at[o], 0)
+        cnt_o = delay_ops.sample_bucket_counts(
+            _shard_key(jax.random.fold_in(k_cm, o), axis), m_o, ow_probs, smode
+        )  # [b1, N]
+        for e in range(b1):
+            arrivals = arrivals.at[o + e].add(cnt_o[e])
+    arr_land = (t0 + off_base + lo + jnp.arange(w_arr)) < t_end  # [w_arr]
+    arrivals = arrivals * arr_land.astype(jnp.int32)[:, None]
+    crossed_c, n_cross_c, _ = _crossing_loop(
+        arrivals, cfg.pbft_commit_need, clean
+    )
+    first_commit = crossed_c.any(axis=0) & active
+    block_num = state.block_num + jnp.where(active, n_cross_c, 0)
+    # last finalization tick of this slot (pbft.step scatters per-tick max;
+    # arrival bucket tau -> tick t0 + off_base + lo + tau... offsets: bucket
+    # index i of `arrivals` is send offset o + e, arrival tick = t0 + o_abs
+    # + e_abs = t0 + (off_base + o) + (lo + e) -> t0 + off_base + lo + i
+    bucket_idx = jnp.arange(w_arr, dtype=jnp.int32)[:, None]
+    last_local = jnp.max(
+        jnp.where(crossed_c, t0 + off_base + lo + bucket_idx, -1)
+    )
+    last_tick = _pmax(last_local, axis)
+    n_first = _psum(first_commit.astype(jnp.int32).sum(), axis)
+    slot_commits = state.slot_commits.at[slot_idx].add(
+        jnp.where(active, first_commit.astype(jnp.int32).sum(), 0), mode="drop"
+    )
+    slot_commit_tick = state.slot_commit_tick.at[slot_idx].max(
+        jnp.where(active & (n_first > 0), last_tick, -1), mode="drop"
+    )
+
+    return state.replace(
+        v=v,
+        leader=leader,
+        next_n=next_n,
+        rounds_sent=rounds_sent,
+        block_num=block_num,
+        view_changes=view_changes,
+        slot_commits=slot_commits,
+        slot_commit_tick=slot_commit_tick,
+        slot_propose_tick=slot_propose_tick,
+    )
+
+
+def metrics(cfg, state) -> dict:
+    """Same measurement surface as the tick engine (pbft.metrics)."""
+    return pbft_tick.metrics(cfg, state)

@@ -407,7 +407,11 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
             lambda: dv.bcast_counts_stat(
                 k_hb,
                 _psum_scalar(plain_send.astype(jnp.int32).sum(), axis),
-                plain_send, ow_probs, drop, axis=axis, mode=smode),
+                # mode stays exact here: this channel has O(1) senders (the
+                # leader), and the Gaussian binomial approximation is biased
+                # for count-1 draws (~9% on a p=1/3 bucket); the sampler-cost
+                # argument for "normal" only applies to O(N)-count channels
+                plain_send, ow_probs, drop, axis=axis, mode="exact"),
             zeros_flat,
             axis,
         )

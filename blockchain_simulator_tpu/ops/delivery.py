@@ -302,3 +302,40 @@ def roundtrip_reply_counts_stat(
             binom(jax.random.fold_in(k, 0x0D11), m, p_keep, mode)
         ).astype(jnp.int32)
     return sample_bucket_counts(k, m, rt_probs, mode)
+
+
+# --------------------------------------------------------------------------- #
+# gossip flood forwarding (kregular topology)                                 #
+# --------------------------------------------------------------------------- #
+
+
+def gossip_fwd(key, fwd_vals, nbrs_loc, n_glob, lo, hi, drop_prob=0.0, axis=None,
+               fold=0x0D22):
+    """TTL-flood forwarding: ``fwd_vals [N_loc, P]`` (>0 TTL-encoded values
+    held by local rows; P = any per-value lane — Paxos proposers, PBFT
+    windows) → ``[B, N_loc, P]`` scatter-max contributions at each sender's
+    out-neighbors (``nbrs_loc [N_loc, deg]`` global ids), one fresh delay draw
+    per (sender, edge, lane).  Sharded: scatter into the global row space,
+    pmax across shards (each shard contributes its senders' forwards), slice
+    the local rows back out."""
+    n_loc, p = fwd_vals.shape
+    deg = nbrs_loc.shape[1]
+    k = _shard_key(key, axis)
+    d = sample_edge_delays(k, (n_loc, deg, p), lo, hi)
+    vals = jnp.broadcast_to(fwd_vals[:, None, :], (n_loc, deg, p))
+    if drop_prob > 0.0:
+        keep = jax.random.bernoulli(
+            jax.random.fold_in(k, fold), 1.0 - drop_prob, (n_loc, deg, p)
+        )
+        vals = vals * keep
+    # one scatter-max over a flattened (bucket, receiver) index — XLA handles
+    # a single big scatter far better than hi-lo separate ones
+    flat_idx = (d - lo) * n_glob + nbrs_loc[:, :, None]  # [n_loc, deg, p]
+    flat = jnp.zeros(((hi - lo) * n_glob, p), jnp.int32)
+    flat = flat.at[flat_idx, jnp.arange(p)[None, None, :]].max(vals)
+    out = flat.reshape(hi - lo, n_glob, p)
+    if axis is not None:
+        out = lax.pmax(out, axis)
+        start = lax.axis_index(axis) * n_loc
+        out = lax.dynamic_slice_in_dim(out, start, n_loc, axis=1)
+    return out

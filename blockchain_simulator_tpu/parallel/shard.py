@@ -22,6 +22,25 @@ from blockchain_simulator_tpu.utils import prng
 from blockchain_simulator_tpu.utils.config import SimConfig
 
 
+def mixed_specs(state, bufs):
+    """PartitionSpecs for the mixed shard-sim (models/mixed.py): raft leaves
+    ``[S, ...]`` row-shard over the shard axis; the S-representative PBFT
+    layer is replicated (every device steps an identical copy — see
+    mixed.step)."""
+    shard0 = lambda x: P(NODES_AXIS, *([None] * (x.ndim - 1)))
+    repl = lambda x: P(*([None] * x.ndim))
+    return (
+        type(state)(
+            raft=jax.tree.map(shard0, state.raft),
+            pbft=jax.tree.map(repl, state.pbft),
+        ),
+        type(bufs)(
+            raft=jax.tree.map(shard0, bufs.raft),
+            pbft=jax.tree.map(repl, bufs.pbft),
+        ),
+    )
+
+
 def node_specs(state, bufs, global_fields=()):
     """PartitionSpecs: state leaves are [N, ...] (shard dim 0) except the
     protocol's ``GLOBAL_FIELDS`` (per-slot accumulators, replicated spec —
@@ -46,20 +65,30 @@ def make_sharded_sim_fn(cfg: SimConfig, mesh: Mesh):
     """Jitted ``sim(key) -> final_state`` with node state sharded over the
     mesh's ``nodes`` axis.  ``cfg.n`` must divide by the axis size."""
     n_shards = mesh.shape[NODES_AXIS]
-    if cfg.protocol == "mixed":
-        raise NotImplementedError(
-            "row-sharding of the mixed shard-sim state is not wired up; "
-            "batch it over the sweep axis instead"
+    if cfg.schedule == "round":
+        raise ValueError(
+            "schedule='round' is not wired for the sharded path (the fast "
+            "path currently runs single-program); use schedule='tick'/'auto' "
+            "with --shards"
         )
-    if cfg.n % n_shards != 0:
-        raise ValueError(f"n={cfg.n} not divisible by {n_shards} node shards")
     proto = get_protocol(cfg.protocol)
     cfg_local = cfg.with_(mesh_axis=NODES_AXIS)
 
     state0, bufs0 = jax.eval_shape(lambda: proto.init(cfg, jax.random.key(0)))
-    state_spec, bufs_spec = node_specs(
-        state0, bufs0, getattr(proto, "GLOBAL_FIELDS", ())
-    )
+    if cfg.protocol == "mixed":
+        # the sharded unit is the raft SHARD row, not the node
+        if cfg.mixed_shards % n_shards != 0:
+            raise ValueError(
+                f"mixed_shards={cfg.mixed_shards} not divisible by "
+                f"{n_shards} mesh shards"
+            )
+        state_spec, bufs_spec = mixed_specs(state0, bufs0)
+    else:
+        if cfg.n % n_shards != 0:
+            raise ValueError(f"n={cfg.n} not divisible by {n_shards} node shards")
+        state_spec, bufs_spec = node_specs(
+            state0, bufs0, getattr(proto, "GLOBAL_FIELDS", ())
+        )
 
     def run(key, state, bufs):
         def body(carry, t):

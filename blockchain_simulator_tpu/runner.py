@@ -21,12 +21,56 @@ from blockchain_simulator_tpu.utils.config import SimConfig
 from blockchain_simulator_tpu.utils.sync import force_sync
 
 
+def use_round_schedule(cfg: SimConfig) -> bool:
+    """Resolve cfg.schedule: does this config run the round-blocked fast path?"""
+    if cfg.protocol != "pbft" or cfg.schedule == "tick":
+        return False
+    from blockchain_simulator_tpu.models import pbft_round
+
+    ok = pbft_round.eligible(cfg)
+    if cfg.schedule == "round":
+        if not ok:
+            raise ValueError(
+                "schedule='round' requires pbft + full mesh + stat delivery "
+                "with no drops, no byz_forge, no serialization, and a message "
+                "horizon inside one block interval (models/pbft_round.eligible)"
+            )
+        return True
+    return ok and cfg.n >= 4096  # "auto"
+
+
 @functools.lru_cache(maxsize=64)
 def make_sim_fn(cfg: SimConfig):
     """Build (and cache) the jitted end-to-end simulation function for a config.
 
-    Returns ``sim(key) -> final_state`` running ``cfg.ticks`` ticks.
+    Returns ``sim(key) -> final_state`` running ``cfg.ticks`` ticks — either
+    the general per-tick engine or, when the config resolves to it, the
+    round-blocked PBFT fast path (one scan step per 50 ms block interval,
+    models/pbft_round.py).
     """
+    if use_round_schedule(cfg):
+        from blockchain_simulator_tpu.models import pbft_round
+
+        bt = cfg.pbft_block_interval_ms
+        # every block tick inside the window runs; the round body masks away
+        # arrivals past cfg.ticks, reproducing the tick engine's mid-flight
+        # truncation of the final rounds' waves
+        r_last = (cfg.ticks - 1) // bt
+
+        @jax.jit
+        def sim_round(key):
+            state, _ = pbft_round.init(cfg, jax.random.fold_in(key, 0x1217))
+            if r_last < 1:
+                return state
+
+            def body(st, r):
+                return pbft_round.step_round(cfg, st, r, key), ()
+
+            state, _ = jax.lax.scan(body, state, jnp.arange(1, r_last + 1))
+            return state
+
+        return sim_round
+
     proto = get_protocol(cfg.protocol)
 
     @jax.jit
@@ -110,6 +154,17 @@ def run_checkpointed(
 
     if every_ms < 1:
         raise ValueError(f"every_ms must be >= 1, got {every_ms}")
+    # Checkpointing segments the general per-tick engine (its carry is the
+    # full (state, bufs) pytree); the round fast path has no tick-granular
+    # segmentation, so pin the schedule rather than silently running a
+    # different simulator than run_simulation would.
+    if use_round_schedule(cfg):
+        if cfg.schedule == "round":
+            raise ValueError(
+                "schedule='round' does not support checkpointing (the round "
+                "fast path is not tick-segmentable); use schedule='tick'"
+            )
+        cfg = cfg.with_(schedule="tick")
     ckpt_dir = pathlib.Path(ckpt_dir)
     ckpt_dir.mkdir(parents=True, exist_ok=True)
     # bake the effective seed into the stored config so resume_simulation

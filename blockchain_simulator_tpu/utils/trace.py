@@ -66,6 +66,11 @@ def run_traced(cfg: SimConfig, seed: int | None = None):
 
     Returns ``(metrics, series)`` where ``series`` maps probe names to
     ``np.ndarray`` of length ``cfg.ticks`` (value *after* each tick).
+
+    Always runs the general per-tick engine (a per-tick series is the whole
+    point); for configs that resolve to the round-blocked fast path the
+    milestone metrics are distribution-identical, not bit-identical, to
+    ``run_simulation`` (see models/pbft_round.py).
     """
     proto = get_protocol(cfg.protocol)
 

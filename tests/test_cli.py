@@ -33,7 +33,7 @@ def test_jax_engine_run(capsys):
 
 def test_cpp_engine_run(capsys):
     (m,) = run_cli(capsys, "--protocol", "raft", "--engine", "cpp",
-                   "--sim-ms", "6000")
+                   "--sim-ms", "6000", "--serialization", "off")
     assert m["protocol"] == "raft"
     assert m["n_leaders"] == 1 and m["blocks"] == 50
 
@@ -53,7 +53,8 @@ def test_fault_flags(capsys):
 
 def test_sharded_flag(capsys):
     (m,) = run_cli(capsys, "--protocol", "pbft", "--n", "16", "--shards", "4",
-                   "--sim-ms", "400", "--pbft-rounds", "5")
+                   "--sim-ms", "400", "--pbft-rounds", "5",
+                   "--serialization", "off")
     assert m["blocks_final_all_nodes"] == 5
 
 

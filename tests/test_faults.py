@@ -1,0 +1,70 @@
+"""Fault sweeps: run_fault_sweep + the per-message drop paths.
+
+Covers the two previously untested claims (VERDICT r2 weak-#4):
+- PBFT's windowed-mode ``unattributed`` counter (pbft.py): with drops, a
+  node can lose a PRE_PREPARE and then receive that slot's COMMIT votes with
+  no tenant to bill them to.
+- Raft's reference-fidelity stall-under-drops (models/raft.py quirk #5: the
+  election timer is never re-armed after the first heartbeat, so lost
+  traffic is never recovered from).
+"""
+
+import pytest
+
+from blockchain_simulator_tpu import SimConfig, run_simulation
+from blockchain_simulator_tpu.parallel.sweep import run_fault_sweep
+from blockchain_simulator_tpu.utils.config import FaultConfig
+
+DROPS = (0.0, 0.01, 0.05)
+
+
+def test_fault_sweep_pbft_drop_monotone():
+    cfg = SimConfig(
+        protocol="pbft", n=32, sim_ms=2500, delivery="stat",
+        pbft_window=8, pbft_max_slots=48, model_serialization=False,
+        schedule="tick",
+    )
+    res = run_fault_sweep(
+        cfg, [FaultConfig(drop_prob=d) for d in DROPS], seeds=[0, 1]
+    )
+    # mean finality degrades monotonically with the drop rate
+    means = [
+        sum(m["blocks_final_all_nodes"] for m in res[fc]) / len(res[fc])
+        for fc in res
+    ]
+    assert means[0] == 40
+    assert means[0] >= means[1] >= means[2]
+    assert means[2] < 40
+
+
+def test_pbft_unattributed_counter_fires_under_drops():
+    cfg = SimConfig(
+        protocol="pbft", n=32, sim_ms=2500, delivery="stat",
+        pbft_window=8, pbft_max_slots=48, model_serialization=False,
+        schedule="tick", faults=FaultConfig(drop_prob=0.05),
+    )
+    m = run_simulation(cfg)
+    # some slots still finalize, and the orphaned votes are accounted for,
+    # not silently dropped
+    assert m["blocks_final_all_nodes"] > 0
+    assert m["unattributed_commits"] > 0
+    assert not m["agreement_ok"]  # unattributed commits void the certificate
+
+
+def test_raft_reference_fidelity_stalls_under_drops():
+    base = dict(protocol="raft", n=16, sim_ms=6000)
+    lossless = run_simulation(SimConfig(**base, fidelity="reference"))
+    assert lossless["blocks"] == 50
+    dropped = run_simulation(
+        SimConfig(**base, fidelity="reference",
+                  faults=FaultConfig(drop_prob=0.05))
+    )
+    # quirk #5: timers never re-arm, so losses are unrecoverable and
+    # replication falls well short of the 50-block milestone
+    assert dropped["blocks"] < 45
+    # clean fidelity re-arms timers and recovers
+    recovered = run_simulation(
+        SimConfig(**base, fidelity="clean",
+                  faults=FaultConfig(drop_prob=0.05))
+    )
+    assert recovered["blocks"] > dropped["blocks"]

@@ -73,12 +73,71 @@ def test_gossip_validation():
         from blockchain_simulator_tpu.models import paxos
 
         paxos.init(GCFG.with_(paxos_retry_timeout_ms=200))
-    # gossip is paxos-only for now
+    # gossip floods exist for paxos (requests) and pbft (blocks); not raft
     with pytest.raises(NotImplementedError):
-        SimConfig(protocol="pbft", topology="kregular")
+        SimConfig(protocol="raft", topology="kregular")
     # reference fidelity has no gossip relay
     with pytest.raises(ValueError, match="full mesh"):
         SimConfig(protocol="paxos", topology="kregular", fidelity="reference")
     # degenerate degree
     with pytest.raises(ValueError, match="degree"):
         kregular_out_neighbors(64, 1, seed=0)
+
+
+# --------------------------------------------------------------------------- #
+# PBFT over the gossip digraph (round-3: block-dissemination floods)          #
+# --------------------------------------------------------------------------- #
+
+PBFT_GCFG = SimConfig(
+    protocol="pbft", n=256, sim_ms=3000, topology="kregular",
+    degree=8, gossip_hops=8, delivery="stat",
+)
+
+
+def test_gossip_pbft_converges():
+    m = run_simulation(PBFT_GCFG)
+    assert m["rounds_sent"] == 40
+    assert m["blocks_final_all_nodes"] == 40
+    assert m["agreement_ok"]
+    assert m["unattributed_commits"] == 0
+    # ~3 store-and-forward hops of a 50 KB block at 3 Mbps dominate finality
+    assert 250 <= m["mean_time_to_finality_ms"] <= 900
+
+
+def test_gossip_pbft_no_serialization_is_fast():
+    m = run_simulation(PBFT_GCFG.with_(model_serialization=False))
+    assert m["blocks_final_all_nodes"] == 40
+    # without the per-hop serialization term finality is a few hop delays
+    assert m["mean_time_to_finality_ms"] <= 120
+
+
+def test_gossip_pbft_determinism():
+    assert run_simulation(PBFT_GCFG) == run_simulation(PBFT_GCFG)
+
+
+def test_gossip_pbft_crashed_relays():
+    cfg = PBFT_GCFG.with_(faults=FaultConfig(n_crashed=32), sim_ms=4000)
+    m = run_simulation(cfg)
+    # floods route around dead relays; every proposed slot still finalizes
+    # at the (alive) majority quorum
+    assert m["blocks_final_all_nodes"] == 40
+    assert m["agreement_ok"]
+
+
+def test_gossip_pbft_sharded():
+    from blockchain_simulator_tpu.parallel.mesh import make_mesh
+    from blockchain_simulator_tpu.parallel.shard import run_sharded
+
+    mesh = make_mesh(n_node_shards=4)
+    m = run_sharded(PBFT_GCFG.with_(n=128, sim_ms=2500), mesh)
+    assert m["blocks_final_all_nodes"] == 40
+    assert m["agreement_ok"]
+
+
+def test_gossip_pbft_requires_exact_window():
+    import pytest as _pytest
+
+    from blockchain_simulator_tpu.models import pbft
+
+    with _pytest.raises(ValueError, match="exact vote-table mode"):
+        pbft.init(PBFT_GCFG.with_(pbft_window=8, pbft_max_slots=64))

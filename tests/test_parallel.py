@@ -101,3 +101,20 @@ def test_graft_entry_dryrun():
     out_state, _ = jax.eval_shape(fn, *args)  # traceable/jittable
     assert out_state.v.shape == args[0].v.shape
     ge.dryrun_multichip(8)
+
+
+def test_sharded_10k_nodes_smoke():
+    """BASELINE config 3's shape at real scale: 10k+ nodes row-sharded over
+    the 8-device mesh with stat delivery — the sharded-at-scale path must
+    actually run, not just its n=64 miniature (VERDICT r2 weak-#6)."""
+    from blockchain_simulator_tpu.parallel.mesh import make_mesh
+    from blockchain_simulator_tpu.parallel.shard import run_sharded
+
+    cfg = SimConfig(
+        protocol="pbft", n=10_240, sim_ms=400, delivery="stat",
+        pbft_window=8, pbft_max_slots=16, model_serialization=False,
+    )
+    m = run_sharded(cfg, make_mesh(n_node_shards=8))
+    assert m["n"] == 10_240
+    assert m["blocks_final_all_nodes"] >= 5
+    assert m["agreement_ok"]

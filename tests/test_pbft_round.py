@@ -1,0 +1,108 @@
+"""Round-blocked PBFT fast path (models/pbft_round.py) vs the tick engine.
+
+The fast path must reproduce the tick engine's milestones for every accepted
+configuration: same rounds/finality counts (delivery is an aggregate model in
+both, so counts match exactly under no faults), same view-change sequence
+(the VC draw uses the identical PRNG channel at each block tick), and
+time-to-finality within the delay distribution's tick-quantization slack.
+"""
+
+import pytest
+
+from blockchain_simulator_tpu.runner import make_sim_fn, run_simulation, use_round_schedule
+from blockchain_simulator_tpu.utils.config import FaultConfig, SimConfig
+
+# serialization off: the round fast path requires rounds to be closed waves
+BASE = dict(protocol="pbft", n=64, sim_ms=2500, delivery="stat",
+            model_serialization=False)
+
+MILESTONES = ("rounds_sent", "blocks_final_all_nodes", "view_changes",
+              "block_num_max", "agreement_ok")
+
+
+def both(cfg_kw):
+    tick = run_simulation(SimConfig(**cfg_kw, schedule="tick"))
+    rnd = run_simulation(SimConfig(**cfg_kw, schedule="round"))
+    return tick, rnd
+
+
+@pytest.mark.parametrize("fidelity", ["clean", "reference"])
+def test_milestones_match_tick_engine(fidelity):
+    tick, rnd = both(dict(**BASE, fidelity=fidelity))
+    for k in MILESTONES:
+        assert rnd[k] == tick[k], k
+    assert abs(rnd["mean_time_to_finality_ms"] - tick["mean_time_to_finality_ms"]) < 3.0
+    assert abs(rnd["last_commit_ms"] - tick["last_commit_ms"]) <= 50.0
+
+
+def test_crash_faults_match():
+    kw = dict(**BASE, faults=FaultConfig(n_crashed=8))
+    tick, rnd = both(kw)
+    for k in MILESTONES:
+        assert rnd[k] == tick[k], k
+
+
+def test_byzantine_slows_but_commits_under_2f1():
+    kw = dict(**BASE, quorum_rule="2f1", faults=FaultConfig(n_byzantine=21))
+    tick, rnd = both(kw)
+    for k in MILESTONES:
+        assert rnd[k] == tick[k], k
+    assert rnd["agreement_ok"]
+
+
+def test_byzantine_majority_stalls_both():
+    # 40 Byzantine of 64: honest voters (24) < N/2 prepare quorum -> no commits
+    kw = dict(**BASE, faults=FaultConfig(n_byzantine=40))
+    tick, rnd = both(kw)
+    assert tick["blocks_final_all_nodes"] == 0
+    assert rnd["blocks_final_all_nodes"] == 0
+
+
+def test_quorum_starved_stalls_both():
+    # crash 6 of 8 (crashes take the last ids, leader 0 stays alive): the two
+    # survivors cannot reach the N/2 prepare quorum -> no finality either way
+    kw = dict(BASE, n=8, faults=FaultConfig(n_crashed=6))
+    tick, rnd = both(kw)
+    assert rnd["blocks_final_all_nodes"] == tick["blocks_final_all_nodes"] == 0
+
+
+def test_truncated_final_wave_matches():
+    # sim window ends 15 ticks after the last block tick: the tick engine
+    # sends that round (rounds_sent counts it, its view-change die is cast)
+    # but its commit wave is cut mid-flight; the round path must reproduce
+    # the same truncation, not drop the round
+    kw = dict(BASE, sim_ms=2465, pbft_max_rounds=60)
+    tick, rnd = both(kw)
+    for k in MILESTONES:
+        assert rnd[k] == tick[k], k
+    assert rnd["last_commit_ms"] == tick["last_commit_ms"]
+
+
+def test_schedule_round_rejects_ineligible():
+    with pytest.raises(ValueError, match="schedule='round'"):
+        make_sim_fn(SimConfig(**BASE, schedule="round",
+                              faults=FaultConfig(drop_prob=0.01)))
+    with pytest.raises(ValueError, match="schedule='round'"):
+        make_sim_fn(SimConfig(protocol="pbft", n=64, sim_ms=2500,
+                              delivery="edge", schedule="round"))
+
+
+def test_auto_resolution():
+    small = SimConfig(**BASE)
+    big = SimConfig(protocol="pbft", n=8192, sim_ms=2500, delivery="stat",
+                    model_serialization=False)
+    dropped = big.with_(faults=FaultConfig(drop_prob=0.01))
+    serialized = big.with_(model_serialization=True)
+    assert not use_round_schedule(small)   # n < 4096 -> tick
+    assert use_round_schedule(big)
+    assert not use_round_schedule(dropped)     # ineligible -> tick
+    assert not use_round_schedule(serialized)  # waves span rounds -> tick
+
+
+def test_exact_sampler_round_mode():
+    # stat_sampler="exact" must work on the fast path too (auto picks normal
+    # only at large n; force both and compare milestones)
+    a = run_simulation(SimConfig(**BASE, schedule="round", stat_sampler="exact"))
+    b = run_simulation(SimConfig(**BASE, schedule="round", stat_sampler="normal"))
+    for k in MILESTONES:
+        assert a[k] == b[k], k

@@ -9,7 +9,7 @@ from blockchain_simulator_tpu import SimConfig, run_simulation
 from blockchain_simulator_tpu.runner import final_state
 from blockchain_simulator_tpu.utils.config import FaultConfig
 
-CFG = SimConfig(protocol="raft", n=8, sim_ms=5000)
+CFG = SimConfig(protocol="raft", n=8, sim_ms=5000, model_serialization=False)
 
 
 def test_raft_8_nodes_reference_milestones():

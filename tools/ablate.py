@@ -40,6 +40,7 @@ def cfg(window=8):
     return SimConfig(
         protocol="pbft", n=N, sim_ms=TICKS, pbft_max_rounds=40,
         pbft_max_slots=48, pbft_window=window, delivery="stat",
+        schedule="tick",  # this tool profiles the TICK engine specifically
     )
 
 
@@ -61,7 +62,7 @@ _orig = {
 }
 
 
-def det_bucket_counts(key, n, probs):
+def det_bucket_counts(key, n, probs, mode="exact"):
     """Deterministic expected-value split: no binomial sampling at all."""
     n = jnp.asarray(n, jnp.int32)
     out, remaining = [], n

@@ -45,6 +45,7 @@ def trial(spec: dict) -> None:
         pbft_max_slots=48,
         pbft_window=spec.get("window", 8),
         delivery="stat",
+        schedule="tick",  # reproduce the program that faulted in round 2
     )
     sim = make_sim_fn(cfg)
     if batch > 1:

@@ -63,6 +63,7 @@ def main() -> None:
             pbft_max_slots=48,
             pbft_window=8,
             delivery="stat",
+            schedule="tick",  # the evidence table is about the tick engine
         )
         print(json.dumps(measure(cfg)))
 

@@ -24,8 +24,12 @@ Semantics match models/pbft.step for every configuration this path accepts
 view-change draw (same PRNG channel at the block tick), same metrics
 surface; delivery randomness is drawn per round instead of per tick, so
 results are distributionally — not bit — identical to the tick engine
-(delivery="stat" is already an aggregate model; tests pin milestone
-equality and distribution closeness).
+(delivery="stat" is already an aggregate model).  Precisely: per-slot
+COUNTS (commits, proposals, view changes — every milestone) are bit-equal,
+because both samplers deliver every message exactly once; per-slot commit
+*ticks* carry +/-1-tick tail jitter (the last threshold-crossing arrival
+falls in a different multinomial bucket under different keys).  Tests pin
+exactly that contract (tests/test_pbft_round.py).
 
 Eligibility (checked statically from the config):
 - protocol "pbft", topology "full", delivery "stat";
@@ -301,6 +305,25 @@ def step_round(cfg, state: PbftRoundState, r, key):
         slot_commit_tick=slot_commit_tick,
         slot_propose_tick=slot_propose_tick,
     )
+
+
+def scan_rounds(cfg, state, key):
+    """Scan every block interval inside the simulation window.
+
+    Shared by the single-chip runner (runner.make_sim_fn) and the node-
+    sharded path (parallel/shard.py), so the truncation semantics — round
+    r runs iff its block tick r*interval < cfg.ticks, with the round body
+    masking arrivals past the window — live in exactly one place."""
+    bt = cfg.pbft_block_interval_ms
+    r_last = (cfg.ticks - 1) // bt
+    if r_last < 1:
+        return state
+
+    def body(st, r):
+        return step_round(cfg, st, r, key), ()
+
+    state, _ = jax.lax.scan(body, state, jnp.arange(1, r_last + 1))
+    return state
 
 
 def metrics(cfg, state) -> dict:

@@ -41,11 +41,11 @@ def mixed_specs(state, bufs):
     )
 
 
-def node_specs(state, bufs, global_fields=()):
-    """PartitionSpecs: state leaves are [N, ...] (shard dim 0) except the
-    protocol's ``GLOBAL_FIELDS`` (per-slot accumulators, replicated spec —
-    each shard carries a partial that the protocol's ``finalize`` combines);
-    buffer leaves are [D, N, ...] (shard dim 1)."""
+def state_specs(state, global_fields=()):
+    """PartitionSpecs for a state pytree: leaves are [N, ...] (shard dim 0)
+    except the protocol's ``GLOBAL_FIELDS`` (per-slot accumulators,
+    replicated spec — each shard carries a partial that the protocol's
+    ``finalize`` combines)."""
 
     def state_leaf_spec(path, x):
         name = path[-1].name if hasattr(path[-1], "name") else None
@@ -53,24 +53,68 @@ def node_specs(state, bufs, global_fields=()):
             return P(*([None] * x.ndim))
         return P(NODES_AXIS, *([None] * (x.ndim - 1)))
 
-    state_spec = jax.tree_util.tree_map_with_path(state_leaf_spec, state)
+    return jax.tree_util.tree_map_with_path(state_leaf_spec, state)
+
+
+def node_specs(state, bufs, global_fields=()):
+    """PartitionSpecs: state leaves per ``state_specs``; buffer leaves are
+    [D, N, ...] (shard dim 1)."""
     bufs_spec = jax.tree.map(
         lambda x: P(None, NODES_AXIS, *([None] * (x.ndim - 2))), bufs
     )
-    return state_spec, bufs_spec
+    return state_specs(state, global_fields), bufs_spec
+
+
+@functools.lru_cache(maxsize=64)
+def _make_sharded_round_fn(cfg: SimConfig, mesh: Mesh):
+    """Node-sharded round-blocked PBFT fast path (models/pbft_round.py):
+    one scan step per 50 ms block interval, node state row-sharded, the
+    per-round reductions (slot max, commit-sender totals, trigger/lands)
+    riding ``psum``/``pmax`` over ICI.  step_round is written against
+    ``cfg.mesh_axis`` exactly like the tick engine's step."""
+    from blockchain_simulator_tpu.models import pbft_round
+
+    n_shards = mesh.shape[NODES_AXIS]
+    if cfg.n % n_shards != 0:
+        raise ValueError(f"n={cfg.n} not divisible by {n_shards} node shards")
+    cfg_local = cfg.with_(mesh_axis=NODES_AXIS)
+
+    state0, _ = jax.eval_shape(lambda: pbft_round.init(cfg, jax.random.key(0)))
+    state_spec = state_specs(state0, pbft_round.GLOBAL_FIELDS)
+
+    def run(key, state):
+        state = pbft_round.scan_rounds(cfg_local, state, key)
+        return pbft_round.finalize(state, NODES_AXIS)
+
+    shmapped = jax.shard_map(
+        run,
+        mesh=mesh,
+        in_specs=(P(), state_spec),
+        out_specs=state_spec,
+        check_vma=False,  # same waiver as the tick path below
+    )
+
+    @jax.jit
+    def sim(key):
+        state, _ = pbft_round.init(cfg, jax.random.fold_in(key, 0x1217))
+        return shmapped(key, state)
+
+    return sim
 
 
 @functools.lru_cache(maxsize=64)
 def make_sharded_sim_fn(cfg: SimConfig, mesh: Mesh):
     """Jitted ``sim(key) -> final_state`` with node state sharded over the
-    mesh's ``nodes`` axis.  ``cfg.n`` must divide by the axis size."""
+    mesh's ``nodes`` axis.  ``cfg.n`` must divide by the axis size.
+
+    Resolves ``cfg.schedule`` exactly like runner.make_sim_fn: the PBFT
+    round-blocked fast path when eligible ('round' explicit, or 'auto' at
+    n >= 4096), else the general per-tick engine."""
+    from blockchain_simulator_tpu.runner import use_round_schedule
+
+    if use_round_schedule(cfg):
+        return _make_sharded_round_fn(cfg, mesh)
     n_shards = mesh.shape[NODES_AXIS]
-    if cfg.schedule == "round":
-        raise ValueError(
-            "schedule='round' is not wired for the sharded path (the fast "
-            "path currently runs single-program); use schedule='tick'/'auto' "
-            "with --shards"
-        )
     proto = get_protocol(cfg.protocol)
     cfg_local = cfg.with_(mesh_axis=NODES_AXIS)
 

@@ -51,23 +51,10 @@ def make_sim_fn(cfg: SimConfig):
     if use_round_schedule(cfg):
         from blockchain_simulator_tpu.models import pbft_round
 
-        bt = cfg.pbft_block_interval_ms
-        # every block tick inside the window runs; the round body masks away
-        # arrivals past cfg.ticks, reproducing the tick engine's mid-flight
-        # truncation of the final rounds' waves
-        r_last = (cfg.ticks - 1) // bt
-
         @jax.jit
         def sim_round(key):
             state, _ = pbft_round.init(cfg, jax.random.fold_in(key, 0x1217))
-            if r_last < 1:
-                return state
-
-            def body(st, r):
-                return pbft_round.step_round(cfg, st, r, key), ()
-
-            state, _ = jax.lax.scan(body, state, jnp.arange(1, r_last + 1))
-            return state
+            return pbft_round.scan_rounds(cfg, state, key)
 
         return sim_round
 

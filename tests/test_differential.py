@@ -94,7 +94,12 @@ def test_raft_crash_minority_differential():
     mj, mc = run_simulation(cfg), run_cpp(cfg)
     # a leader still emerges from the 5 alive nodes in both engines
     assert mc["n_leaders"] >= 1 and mj["n_leaders"] >= 1
-    assert mc["blocks"] == mj["blocks"] == 50
+    # 49, not 50, for the same serialization reason as test_raft_differential
+    # (clean fidelity): round r's acks return one heartbeat window late, so
+    # the final round's acks land in an already-latched window.  The crash
+    # only shrinks the ack pool (4 of 4 needed instead of 5 of 7); the
+    # one-window-late pipeline is unchanged.  Both engines agree at 49.
+    assert mc["blocks"] == mj["blocks"] == 49
 
 
 def test_paxos_crash_differential():
@@ -129,6 +134,9 @@ def test_cpp_paxos_safety_sweep():
 def test_cpp_scales_to_thousands():
     # the serial engine handles mid-scale N (the reference's ns-3 app cannot:
     # O(N^2) link setup alone, SURVEY.md §5); beyond ~10k the JAX path owns it
-    m = run_cpp(SimConfig(protocol="pbft", n=500, sim_ms=300, pbft_max_rounds=4))
+    # 450 ms window: a 50 KB block serializes for ~133 ms per broadcast leg
+    # (model_serialization default-on), so round 4 (sent at t=200) finalizes
+    # at ~362 ms
+    m = run_cpp(SimConfig(protocol="pbft", n=500, sim_ms=450, pbft_max_rounds=4))
     assert m["blocks_final_all_nodes"] == 4
     assert m["agreement_ok"]

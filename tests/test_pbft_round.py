@@ -70,12 +70,41 @@ def test_truncated_final_wave_matches():
     # sim window ends 15 ticks after the last block tick: the tick engine
     # sends that round (rounds_sent counts it, its view-change die is cast)
     # but its commit wave is cut mid-flight; the round path must reproduce
-    # the same truncation, not drop the round
+    # the same truncation, not drop the round.
+    #
+    # Contract pinned here (root cause of the former exact-equality failure,
+    # round 3): per-slot COUNTS are bit-equal between engines — delivery in
+    # both is the same aggregate model, so every message lands exactly once —
+    # but the *tick* of the last arrival inside a wave is drawn with per-round
+    # keys on the fast path vs per-tick [N, W]-shaped keys on the tick engine,
+    # so it carries +/-1-tick tail jitter in EVERY round (both directions; not
+    # a truncation bug — reproducing the tick engine's draws bit-for-bit would
+    # need the very O(N*W)-shaped per-tick sampling the fast path removes).
+    import numpy as np
+
+    from blockchain_simulator_tpu.runner import final_state
+
     kw = dict(BASE, sim_ms=2465, pbft_max_rounds=60)
     tick, rnd = both(kw)
     for k in MILESTONES:
         assert rnd[k] == tick[k], k
-    assert rnd["last_commit_ms"] == tick["last_commit_ms"]
+    assert abs(rnd["last_commit_ms"] - tick["last_commit_ms"]) <= 2.0
+    st_t = final_state(SimConfig(**kw, schedule="tick"))
+    st_r = final_state(SimConfig(**kw, schedule="round"))
+    np.testing.assert_array_equal(st_r.slot_commits, st_t.slot_commits)
+    np.testing.assert_array_equal(st_r.slot_propose_tick, st_t.slot_propose_tick)
+    # the final proposed slot (block tick 2450, wave cut at 2465) must be
+    # proposed-but-uncommitted in BOTH engines
+    pt = np.asarray(st_t.slot_propose_tick)
+    last_slot = int(np.nonzero(pt < np.iinfo(np.int32).max)[0].max())
+    assert pt[last_slot] == 2450
+    assert int(np.asarray(st_t.slot_commits)[last_slot]) == 0
+    assert int(np.asarray(st_r.slot_commits)[last_slot]) == 0
+    # committed slots' finality ticks agree within the tail jitter
+    ct_t = np.asarray(st_t.slot_commit_tick)
+    ct_r = np.asarray(st_r.slot_commit_tick)
+    done = np.asarray(st_t.slot_commits) > 0
+    assert int(np.abs(ct_t - ct_r)[done].max()) <= 1
 
 
 def test_schedule_round_rejects_ineligible():

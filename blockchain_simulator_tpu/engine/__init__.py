@@ -55,6 +55,9 @@ class _CppCfg(ctypes.Structure):
         ("drop_prob", ctypes.c_double),
         ("ser_pbft", ctypes.c_int32),
         ("ser_raft", ctypes.c_int32),
+        ("echo", ctypes.c_int32),
+        ("paxos_client_node", ctypes.c_int32),
+        ("paxos_client_ms", ctypes.c_int32),
     ]
 
 
@@ -162,6 +165,9 @@ def cpp_config(cfg, seed: int | None = None) -> _CppCfg:
         drop_prob=cfg.faults.drop_prob,
         ser_pbft=cfg.serialization_ticks(cfg.pbft_block_bytes),
         ser_raft=cfg.serialization_ticks(cfg.raft_block_bytes),
+        echo=1 if cfg.echo_back else 0,
+        paxos_client_node=cfg.paxos_client_node,
+        paxos_client_ms=cfg.paxos_client_ms,
     )
 
 

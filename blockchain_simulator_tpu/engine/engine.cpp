@@ -83,6 +83,23 @@ struct SimCfg {
   // engines (see SimConfig.model_serialization).
   int32_t ser_pbft;
   int32_t ser_raft;
+  // quirk #1 fidelity (bounded): reflect every received packet back to its
+  // sender ONCE (pbft-node.cc:175, raft-node.cc:136, paxos-node.cc:158).
+  // The upstream reflects unconditionally, so reflections of reflections
+  // ping-pong forever and its event queue never drains; here a reflected
+  // copy is marked and never re-reflected — the receiver still processes it
+  // through the normal FSM exactly as the upstream HandleRead would (echoed
+  // PREPAREs draw PREPARE_RES replies, echoed requests draw responses, the
+  // rest lands in the "wrong msg" default), reproducing the upstream's
+  // traffic inflation to first order.  0 = off (default; the JAX engines
+  // never model echo — tests/test_fidelity.py pins the delta).
+  int32_t echo;
+  // Paxos CLIENT_PROPOSE external-client hook (paxos-node.cc:357-361):
+  // proposer lane `paxos_client_node` (< paxos_p; -1 = none) does not fire
+  // requireTicket at t=0 — a simulated client triggers it at
+  // `paxos_client_ms` instead.
+  int32_t paxos_client_node;
+  int32_t paxos_client_ms;
 };
 
 // ---------------------------------------------------------------------------
@@ -94,6 +111,7 @@ struct Msg {
   int32_t type;
   int32_t from;
   int32_t a, b, c;  // protocol-specific fields (view/slot/ticket/command/...)
+  int32_t refl;     // 1 = an echo reflection (never re-reflected; cfg.echo)
 };
 
 struct Event {
@@ -133,6 +151,7 @@ class Sim {
   std::priority_queue<Event, std::vector<Event>, EventCmp> q;
   int64_t now = 0;
   int64_t seq = 0;
+  int64_t delivered = 0;  // messages processed (traffic metric; echo tests)
 
   int32_t rand_int(int32_t lo, int32_t hi) {  // uniform in [lo, hi); hi<=lo → lo
     if (hi <= lo) return lo;
@@ -477,7 +496,12 @@ struct Engine {
       nd.proposal = i;  // proposal = '0'+m_id (paxos-node.cc:66)
       if (i < c.paxos_p) {
         nd.phase = 0;
-        if (nd.alive) sim.schedule_timer(i, T_START, 0);  // paxos-node.cc:136-138
+        if (nd.alive) {
+          // CLIENT_PROPOSE hook (paxos-node.cc:357-361): the client lane
+          // starts when the simulated external client says so, not at t=0
+          int64_t at = (i == c.paxos_client_node) ? c.paxos_client_ms : 0;
+          sim.schedule_timer(i, T_START, at);  // paxos-node.cc:136-138
+        }
       }
     }
   }
@@ -692,6 +716,17 @@ void run_loop(E& eng) {
       // timer events carry their scheduling seq as the cancellation token
       eng.on_timer(nd, ev.timer, ev.seq);
     } else {
+      sim.delivered++;
+      if (sim.cfg.echo && ev.msg.refl == 0) {
+        // quirk #1 (bounded): reflect the packet to its sender once; the
+        // reflected copy arrives as a normal message "from" the reflector
+        // (the upstream replies to the socket's from-address) and is never
+        // itself reflected, so the queue still drains
+        Msg r = ev.msg;
+        r.from = ev.node;
+        r.refl = 1;
+        sim.send(ev.msg.from, r);
+      }
       eng.on_msg(nd, ev.msg);
     }
   }
@@ -739,10 +774,11 @@ std::string json_pbft(pbft::Engine& eng) {
       "{\"protocol\": \"pbft\", \"n\": %d, \"rounds_sent\": %d, "
       "\"leader_rounds_max\": %d, \"blocks_final_all_nodes\": %d, "
       "\"block_num_max\": %d, \"view_changes\": %d, \"last_commit_ms\": %.1f, "
-      "\"mean_time_to_finality_ms\": %.6g, \"agreement_ok\": %s}",
+      "\"mean_time_to_finality_ms\": %.6g, \"delivered_msgs\": %lld, "
+      "\"agreement_ok\": %s}",
       c.n, rounds, lead_rounds, final_all, bn_max, vcs,
       static_cast<double>(last), final_all ? ttf_sum / final_all : -1.0,
-      agree ? "true" : "false");
+      static_cast<long long>(eng.sim.delivered), agree ? "true" : "false");
   return buf;
 }
 
@@ -774,10 +810,12 @@ std::string json_raft(raft::Engine& eng) {
       "{\"protocol\": \"raft\", \"n\": %d, \"n_leaders\": %d, \"leader\": %d, "
       "\"leader_elected_ms\": %.1f, \"blocks\": %d, \"rounds\": %d, "
       "\"elections\": %d, \"last_block_ms\": %.1f, "
-      "\"mean_block_interval_ms\": %.6g, \"agreement_ok\": %s}",
+      "\"mean_block_interval_ms\": %.6g, \"delivered_msgs\": %lld, "
+      "\"agreement_ok\": %s}",
       c.n, n_leaders, lead,
       lead >= 0 ? double(eng.nodes[lead].leader_tick) : -1.0, blocks, rounds,
-      elections, last_block, mean_int, agree ? "true" : "false");
+      elections, last_block, mean_int,
+      static_cast<long long>(eng.sim.delivered), agree ? "true" : "false");
   return buf;
 }
 
@@ -814,11 +852,12 @@ std::string json_paxos(paxos::Engine& eng) {
       "\"winner\": %d, \"winner_commit_ms\": %.1f, \"winner_ticket\": %d, "
       "\"max_ticket\": %d, \"retries\": %d, \"acceptor_executes\": %d, "
       "\"first_execute_ms\": %.1f, \"decided_command\": %d, \"gave_up\": %d, "
-      "\"agreement_ok\": %s}",
+      "\"delivered_msgs\": %lld, \"agreement_ok\": %s}",
       c.n, n_committed, winner,
       winner >= 0 ? double(eng.nodes[winner].commit_tick) : -1.0,
       winner >= 0 ? eng.nodes[winner].ticket : -1, max_ticket, retries,
-      executes, double(first_exec), decided, gave_up, agree ? "true" : "false");
+      executes, double(first_exec), decided, gave_up,
+      static_cast<long long>(eng.sim.delivered), agree ? "true" : "false");
   return buf;
 }
 

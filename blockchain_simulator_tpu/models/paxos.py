@@ -443,8 +443,16 @@ def step(cfg, state: PaxosState, bufs: PaxosBufs, t, tkey):
     gave_up = state.gave_up | (want_retry & ~can_retry)
 
     # first firing: nodes 0..P-1 schedule requireTicket at t=0
-    # (paxos-node.cc:136-138)
+    # (paxos-node.cc:136-138); a designated client lane instead fires when
+    # the simulated external client sends CLIENT_PROPOSE
+    # (paxos-node.cc:357-361, cfg.paxos_client_node/_ms)
     fire0 = (jnp.int32(t) == 0) & (ids < p) & state.alive
+    cn = cfg.paxos_client_node
+    if cn >= 0:
+        is_client = ids == cn
+        fire0 = (fire0 & ~is_client) | (
+            (jnp.int32(t) == cfg.paxos_client_ms) & is_client & state.alive
+        )
     send_tk = fire0 | retry
     ticket = jnp.where(send_tk, state.ticket + 1, state.ticket)
 

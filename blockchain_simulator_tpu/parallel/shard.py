@@ -112,6 +112,11 @@ def make_sharded_sim_fn(cfg: SimConfig, mesh: Mesh):
     n >= 4096), else the general per-tick engine."""
     from blockchain_simulator_tpu.runner import use_round_schedule
 
+    if cfg.echo_back:
+        raise NotImplementedError(
+            "echo_back (quirk #1) is modeled by the C++ engine only "
+            "(engine.run_cpp); the tensorized backends design the echo away"
+        )
     if use_round_schedule(cfg):
         return _make_sharded_round_fn(cfg, mesh)
     n_shards = mesh.shape[NODES_AXIS]

@@ -48,6 +48,12 @@ def make_sim_fn(cfg: SimConfig):
     round-blocked PBFT fast path (one scan step per 50 ms block interval,
     models/pbft_round.py).
     """
+    if cfg.echo_back:
+        raise NotImplementedError(
+            "echo_back (quirk #1) is modeled by the C++ engine only "
+            "(engine.run_cpp): the tensorized backends design the echo away "
+            "— see models/pbft.py docstring"
+        )
     if use_round_schedule(cfg):
         from blockchain_simulator_tpu.models import pbft_round
 
@@ -109,6 +115,11 @@ def make_segment_fn(cfg: SimConfig, n_ticks: int):
     keys derive from the absolute tick (utils/prng.py), segmented execution is
     bit-identical to one uninterrupted scan — the checkpoint/resume substrate
     (the reference has none, SURVEY.md §5)."""
+    if cfg.echo_back:
+        raise NotImplementedError(
+            "echo_back (quirk #1) is modeled by the C++ engine only "
+            "(engine.run_cpp); the tensorized backends design the echo away"
+        )
     proto = get_protocol(cfg.protocol)
 
     @jax.jit

@@ -172,6 +172,20 @@ class SimConfig:
     # higher ticket (~2x the 106 ms max round trip).  The reference has no
     # timeout — a lost reply wedges its proposer forever; reference fidelity
     # reproduces that stall.
+    # CLIENT_PROPOSE external-client hook (paxos-node.cc:357-361): proposer
+    # lane `paxos_client_node` (must be < paxos_n_proposers; -1 = none) does
+    # not fire requireTicket at t=0 — a simulated client triggers it at
+    # `paxos_client_ms` instead (mid-run injection; both engines).
+    paxos_client_node: int = -1
+    paxos_client_ms: int = 0
+
+    # --- echo-back fidelity (quirk #1) ---------------------------------------
+    # Reflect every received packet to its sender once (never re-reflect):
+    # the bounded variant of the reference's unconditional echo
+    # (pbft-node.cc:175, raft-node.cc:136, paxos-node.cc:158), which would
+    # ping-pong forever.  Modeled by the C++ engine only — the tensorized
+    # backends design echo away (models/pbft.py docstring) and refuse it.
+    echo_back: bool = False
 
     # --- mixed-protocol shard sim (BASELINE config 5) ------------------------
     mixed_shards: int = 16  # number of raft shards; shard size = n // shards;
@@ -222,6 +236,21 @@ class SimConfig:
             raise ValueError(
                 f"paxos_n_proposers={self.paxos_n_proposers} must be in [1, n={self.n}]"
             )
+        if self.paxos_client_node >= 0:
+            if self.protocol != "paxos":
+                raise ValueError("paxos_client_node requires protocol='paxos'")
+            if self.paxos_client_node >= self.paxos_n_proposers:
+                raise ValueError(
+                    f"paxos_client_node={self.paxos_client_node} must be a "
+                    f"proposer lane (< paxos_n_proposers="
+                    f"{self.paxos_n_proposers}): lanes are the static "
+                    "proposer channel layout in both engines"
+                )
+            if not 0 <= self.paxos_client_ms < self.sim_ms:
+                raise ValueError(
+                    f"paxos_client_ms={self.paxos_client_ms} outside the "
+                    f"simulation window [0, {self.sim_ms})"
+                )
         if self.topology == "kregular":
             if self.protocol not in ("paxos", "pbft"):
                 raise NotImplementedError(

@@ -73,6 +73,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="flood TTL (kregular)")
     p.add_argument("--paxos-timeout-ms", type=int, default=d.paxos_retry_timeout_ms,
                    help="clean-fidelity retry window timeout")
+    p.add_argument("--paxos-client", nargs=2, type=int, default=None,
+                   metavar=("NODE", "MS"),
+                   help="CLIENT_PROPOSE hook (paxos-node.cc:357-361): proposer "
+                        "lane NODE fires requireTicket at MS instead of t=0")
+    # C++-engine-only transport/fidelity extras
+    p.add_argument("--echo-back", action="store_true",
+                   help="reflect every received packet to its sender once "
+                        "(bounded quirk #1; --engine cpp only)")
+    p.add_argument("--queued-links", action="store_true",
+                   help="ns-3-exact serial-link transport: packets queue per "
+                        "directed 3 Mbps link (--engine cpp only)")
     p.add_argument("--quorum-rule", choices=["n2", "2f1"], default=d.quorum_rule,
                    help="n2 = reference majority thresholds (no vote dedup); "
                         "2f1 = Byzantine-safe 2f+1 quorum with per-sender dedup")
@@ -135,6 +146,10 @@ def config_from_args(args) -> SimConfig:
         degree=args.degree,
         gossip_hops=args.gossip_hops,
         paxos_retry_timeout_ms=args.paxos_timeout_ms,
+        paxos_client_node=args.paxos_client[0] if args.paxos_client else -1,
+        paxos_client_ms=args.paxos_client[1] if args.paxos_client else 0,
+        echo_back=args.echo_back,
+        queued_links=args.queued_links,
         pbft_block_interval_ms=args.pbft_interval_ms,
         pbft_max_rounds=args.pbft_rounds,
         pbft_max_slots=args.pbft_max_slots,
@@ -157,6 +172,12 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
     seeds = args.seeds if args.seeds is not None else [args.seed]
+
+    if args.engine != "cpp" and (args.echo_back or args.queued_links):
+        print("error: --echo-back/--queued-links require --engine cpp (the "
+              "tensorized backends model neither; see SimConfig docs)",
+              file=sys.stderr)
+        return 2
 
     if args.engine == "cpp":
         if args.shards > 1:

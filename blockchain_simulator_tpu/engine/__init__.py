@@ -55,6 +55,8 @@ class _CppCfg(ctypes.Structure):
         ("drop_prob", ctypes.c_double),
         ("ser_pbft", ctypes.c_int32),
         ("ser_raft", ctypes.c_int32),
+        ("queued_links", ctypes.c_int32),
+        ("link_prop", ctypes.c_int32),
         ("echo", ctypes.c_int32),
         ("paxos_client_node", ctypes.c_int32),
         ("paxos_client_ms", ctypes.c_int32),
@@ -168,6 +170,8 @@ def cpp_config(cfg, seed: int | None = None) -> _CppCfg:
         echo=1 if cfg.echo_back else 0,
         paxos_client_node=cfg.paxos_client_node,
         paxos_client_ms=cfg.paxos_client_ms,
+        queued_links=1 if cfg.queued_links else 0,
+        link_prop=cfg.link_delay_ms,
     )
 
 

@@ -83,6 +83,19 @@ struct SimCfg {
   // engines (see SimConfig.model_serialization).
   int32_t ser_pbft;
   int32_t ser_raft;
+  // Queued-link transport (ns-3 fidelity): each directed (from, to) link is
+  // a serial 3 Mbps pipe — a packet's transmission starts when the link is
+  // free (max(ready, busy_until)), occupies it for its serialization time,
+  // then propagates.  The constant-latency default charges serialization as
+  // a fixed per-message term instead; at reference PBFT defaults that is a
+  // real divergence (a 50 KB block serializes ~136 ms but blocks depart
+  // every 50 ms, so the upstream's per-link queues grow ~86 ms per round —
+  // tests/test_fidelity.py quantifies it).  0 = constant-latency (default,
+  // matches the JAX engines); 1 = queued.
+  int32_t queued_links;
+  int32_t link_prop;  // propagation ms (blockchain-simulator.cc:24); the
+  // random scheduling delay is delay() - link_prop (one_way_range collapses
+  // sched + prop into [delay_lo, delay_hi))
   // quirk #1 fidelity (bounded): reflect every received packet back to its
   // sender ONCE (pbft-node.cc:175, raft-node.cc:136, paxos-node.cc:158).
   // The upstream reflects unconditionally, so reflections of reflections
@@ -112,15 +125,17 @@ struct Msg {
   int32_t from;
   int32_t a, b, c;  // protocol-specific fields (view/slot/ticket/command/...)
   int32_t refl;     // 1 = an echo reflection (never re-reflected; cfg.echo)
+  int32_t ser;      // this message's serialization ticks (set by send();
+                    // reflections reuse it so echoed blocks keep block timing)
 };
 
 struct Event {
   int64_t t;
   int64_t seq;
-  int32_t node;   // receiver (message) or owner (timer)
-  int32_t kind;   // 0 = message, 1 = timer
+  int32_t node;   // receiver (message/enqueue) or owner (timer)
+  int32_t kind;   // 0 = message delivery, 1 = timer, 2 = link enqueue
   int32_t timer;  // timer id when kind == 1
-  Msg msg;        // payload when kind == 0
+  Msg msg;        // payload when kind == 0 or 2
 };
 
 struct EventCmp {
@@ -144,7 +159,10 @@ struct NodeBase {
 // ---------------------------------------------------------------------------
 class Sim {
  public:
-  explicit Sim(const SimCfg& c) : cfg(c), rng(static_cast<uint64_t>(c.seed)) {}
+  explicit Sim(const SimCfg& c) : cfg(c), rng(static_cast<uint64_t>(c.seed)) {
+    if (c.queued_links)
+      busy_until.assign(static_cast<size_t>(c.n) * c.n, 0);
+  }
 
   const SimCfg cfg;
   std::mt19937_64 rng;
@@ -152,6 +170,7 @@ class Sim {
   int64_t now = 0;
   int64_t seq = 0;
   int64_t delivered = 0;  // messages processed (traffic metric; echo tests)
+  std::vector<int64_t> busy_until;  // per directed edge, queued_links mode
 
   int32_t rand_int(int32_t lo, int32_t hi) {  // uniform in [lo, hi); hi<=lo → lo
     if (hi <= lo) return lo;
@@ -170,10 +189,29 @@ class Sim {
     q.push(Event{at, seq++, node, 1, timer, Msg{}});
   }
   // unicast with a fresh delay draw + drop roll (the reference defers every
-  // send via Simulator::Schedule(getRandomDelay(), ...), SURVEY.md C8)
+  // send via Simulator::Schedule(getRandomDelay(), ...), SURVEY.md C8).
+  // ``extra`` is the message's serialization time (0 for 3-4-byte votes).
   void send(int32_t to, const Msg& m, int32_t extra = 0) {
     if (dropped()) return;
-    schedule_msg(to, m, delay() + extra);
+    Msg mm = m;
+    mm.ser = extra;
+    if (cfg.queued_links) {
+      // ns-3 transport: after the random scheduling delay the packet REACHES
+      // the serial (from, to) link; the link is reserved at that moment — in
+      // link-arrival order, not send-call order (two sends whose scheduling
+      // draws invert must transmit in arrival order) — so the reservation
+      // runs as its own event (kind 2 in run_loop)
+      q.push(Event{now + (delay() - cfg.link_prop), seq++, to, 2, 0, mm});
+      return;
+    }
+    schedule_msg(to, mm, delay() + extra);
+  }
+  // kind-2 handler: reserve the link now, deliver after transmit + propagate
+  void link_enqueue(int32_t to, const Msg& m) {
+    int64_t& busy = busy_until[static_cast<size_t>(m.from) * cfg.n + to];
+    int64_t start = std::max(now, busy);
+    busy = start + m.ser;
+    schedule_msg(to, m, static_cast<int32_t>(start + m.ser + cfg.link_prop - now));
   }
   // broadcast to all peers except self (and optionally except the sender's
   // first peer — the Paxos iterator bug, paxos-node.cc:478-496)
@@ -710,6 +748,12 @@ void run_loop(E& eng) {
     sim.q.pop();
     if (ev.t >= horizon) break;  // apps stop at the window end
     sim.now = ev.t;
+    if (ev.kind == 2) {
+      // link reservation is sender-side: it happens even when the receiver
+      // is crashed (the packet still occupies the pipe in ns-3)
+      sim.link_enqueue(ev.node, ev.msg);
+      continue;
+    }
     auto& nd = eng.nodes[ev.node];
     if (!nd.alive) continue;  // crashed nodes process nothing
     if (ev.kind == 1) {
@@ -721,11 +765,13 @@ void run_loop(E& eng) {
         // quirk #1 (bounded): reflect the packet to its sender once; the
         // reflected copy arrives as a normal message "from" the reflector
         // (the upstream replies to the socket's from-address) and is never
-        // itself reflected, so the queue still drains
+        // itself reflected, so the queue still drains.  The reflection
+        // retransmits the FULL packet, so it keeps the original's
+        // serialization time (an echoed 50 KB block is still 50 KB)
         Msg r = ev.msg;
         r.from = ev.node;
         r.refl = 1;
-        sim.send(ev.msg.from, r);
+        sim.send(ev.msg.from, r, ev.msg.ser);
       }
       eng.on_msg(nd, ev.msg);
     }

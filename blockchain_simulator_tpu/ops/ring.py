@@ -31,16 +31,26 @@ def ring_pop(buf, t):
     )
 
 
-def _push(buf, t, lo: int, contrib, combine):
+def _push(buf, t, lo: int, contrib, op: str):
     """Combine ``contrib[b, ...]`` into slices ``t+lo+b``, b in [0, B).
 
-    Unrolled over the (small, static) bucket axis as dynamic-slice /
-    dynamic-update-slice pairs: a ``buf.at[idx_vector].add`` lowers to XLA
-    generic scatter, which TPUs execute catastrophically slowly — the round-3
-    ablation (tools/ablate.py) measured the scatter form at ~2.0 ms/tick of a
-    2.24 ms/tick total at N=100k; the DUS form is ~30x faster.  In-place
-    update is preserved (each step is a DUS on the scan-carried buffer).
+    Two lowerings:
+
+    - **pallas** (TPU): one fused in-place kernel touching exactly the B
+      addressed ring slices (ops/ring_kernel.py) — the bandwidth floor.
+    - **DUS chain** (fallback): unrolled dynamic-slice / dynamic-update-slice
+      pairs over the (small, static) bucket axis.  A ``buf.at[idx_vec].add``
+      would lower to XLA generic scatter, which TPUs execute catastrophically
+      slowly — the round-3 ablation (tools/ablate.py) measured the scatter
+      form ~30x slower than this chain; the pallas kernel removes the chain's
+      remaining per-pair copy cost (round-4 measurement in
+      ARTIFACT_ring_kernel.json).
     """
+    from blockchain_simulator_tpu.ops import ring_kernel
+
+    if ring_kernel.enabled() and ring_kernel.pushable(buf, contrib):
+        return ring_kernel.fused_push(buf, t, lo, contrib, op)
+    combine = jnp.add if op == "add" else jnp.maximum
     d = buf.shape[0]
     for b in range(contrib.shape[0]):
         idx = jnp.mod(t + lo + b, d)
@@ -51,9 +61,9 @@ def _push(buf, t, lo: int, contrib, combine):
 
 def ring_push_add(buf, t, lo: int, contrib):
     """Add ``contrib[b, ...]`` into slices ``t+lo+b``, b in [0, B)."""
-    return _push(buf, t, lo, contrib, lambda cur, c: cur + c)
+    return _push(buf, t, lo, contrib, "add")
 
 
 def ring_push_max(buf, t, lo: int, contrib):
     """Max-combine (for value channels where 0 == empty)."""
-    return _push(buf, t, lo, contrib, jnp.maximum)
+    return _push(buf, t, lo, contrib, "max")

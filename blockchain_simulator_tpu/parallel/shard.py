@@ -110,13 +110,9 @@ def make_sharded_sim_fn(cfg: SimConfig, mesh: Mesh):
     Resolves ``cfg.schedule`` exactly like runner.make_sim_fn: the PBFT
     round-blocked fast path when eligible ('round' explicit, or 'auto' at
     n >= 4096), else the general per-tick engine."""
-    from blockchain_simulator_tpu.runner import use_round_schedule
+    from blockchain_simulator_tpu.runner import _reject_cpp_only, use_round_schedule
 
-    if cfg.echo_back:
-        raise NotImplementedError(
-            "echo_back (quirk #1) is modeled by the C++ engine only "
-            "(engine.run_cpp); the tensorized backends design the echo away"
-        )
+    _reject_cpp_only(cfg)
     if use_round_schedule(cfg):
         return _make_sharded_round_fn(cfg, mesh)
     n_shards = mesh.shape[NODES_AXIS]

@@ -39,6 +39,23 @@ def use_round_schedule(cfg: SimConfig) -> bool:
     return ok and cfg.n >= 4096  # "auto"
 
 
+def _reject_cpp_only(cfg: SimConfig) -> None:
+    """Refuse fidelity modes only the C++ engine models, rather than
+    silently returning constant-latency / echo-free numbers for them."""
+    if cfg.echo_back:
+        raise NotImplementedError(
+            "echo_back (quirk #1) is modeled by the C++ engine only "
+            "(engine.run_cpp): the tensorized backends design the echo away "
+            "— see models/pbft.py docstring"
+        )
+    if cfg.queued_links:
+        raise NotImplementedError(
+            "queued_links (ns-3 serial-link transport) is modeled by the "
+            "C++ engine only (engine.run_cpp); the tensorized backends use "
+            "the constant-serialization model (SimConfig.model_serialization)"
+        )
+
+
 @functools.lru_cache(maxsize=64)
 def make_sim_fn(cfg: SimConfig):
     """Build (and cache) the jitted end-to-end simulation function for a config.
@@ -48,12 +65,7 @@ def make_sim_fn(cfg: SimConfig):
     round-blocked PBFT fast path (one scan step per 50 ms block interval,
     models/pbft_round.py).
     """
-    if cfg.echo_back:
-        raise NotImplementedError(
-            "echo_back (quirk #1) is modeled by the C++ engine only "
-            "(engine.run_cpp): the tensorized backends design the echo away "
-            "— see models/pbft.py docstring"
-        )
+    _reject_cpp_only(cfg)
     if use_round_schedule(cfg):
         from blockchain_simulator_tpu.models import pbft_round
 
@@ -115,11 +127,7 @@ def make_segment_fn(cfg: SimConfig, n_ticks: int):
     keys derive from the absolute tick (utils/prng.py), segmented execution is
     bit-identical to one uninterrupted scan — the checkpoint/resume substrate
     (the reference has none, SURVEY.md §5)."""
-    if cfg.echo_back:
-        raise NotImplementedError(
-            "echo_back (quirk #1) is modeled by the C++ engine only "
-            "(engine.run_cpp); the tensorized backends design the echo away"
-        )
+    _reject_cpp_only(cfg)
     proto = get_protocol(cfg.protocol)
 
     @jax.jit

@@ -74,12 +74,20 @@ class SimConfig:
     # Raft proposals ~54 ms (raft-node.cc:409) — the dominant timing term of
     # the system being reproduced.  Simplification (documented divergence):
     # links are NOT queued — serialization is a constant per-message latency,
-    # whereas ns-3 queues back-to-back packets per link; with the reference's
-    # one-block-every-50ms workload the queues never build beyond the block
-    # message itself, so the first-order effect is the same.  Set False to
-    # model propagation + the explicit random scheduling delay only (the
-    # round-blocked PBFT fast path requires this).
+    # whereas ns-3 queues back-to-back packets per link.  At the reference
+    # PBFT defaults this is a REAL divergence: a 50 KB block serializes
+    # ~136 ms but blocks depart every 50 ms, so the upstream's per-link
+    # queues grow ~86 ms per round and its time-to-finality drifts linearly
+    # (quantified in tests/test_fidelity.py via queued_links below).  Set
+    # False to model propagation + the explicit random scheduling delay only
+    # (the round-blocked PBFT fast path requires this).
     model_serialization: bool = True
+    # ns-3-exact queued transport (C++ engine only): each directed link is a
+    # serial 3 Mbps pipe — a packet transmits when the link is free, occupies
+    # it for its serialization time, then propagates; small votes queue
+    # behind blocks on the same link.  The tensorized backends keep the
+    # constant-latency model and refuse this flag.
+    queued_links: bool = False
 
     # --- topology -----------------------------------------------------------
     topology: str = "full"  # "full" (reference, blockchain-simulator.cc:34-51)

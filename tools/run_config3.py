@@ -91,7 +91,8 @@ def main() -> None:
     if _os.path.exists(path):
         with open(path) as f:
             prev = json.load(f)
-        if prev.get("n") == n and prev.get("sim_ms") == sim_ms:
+        if (prev.get("n") == n and prev.get("sim_ms") == sim_ms
+                and prev.get("degree") == degree):
             for k in ("sharded", "single"):
                 if k in prev and k not in out:
                     out[k] = prev[k]

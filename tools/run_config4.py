@@ -1,0 +1,81 @@
+"""BASELINE config 4 at real scale: PBFT, 100k nodes, Byzantine-fault sweep
+f = 0..n/3.  Writes ARTIFACT_config4.json at the repo root.
+
+Each f value runs the round-blocked fast path (vote-flipping Byzantine nodes
+are round-path eligible; models/pbft_round.eligible) as its own jitted run —
+the sweep axis of BASELINE's "pmap over fault configs" generalizes to
+sequential fault points on one chip (parallel/sweep.py batches seeds when a
+mesh axis is free).  Under the reference's n2 quorum rule, flipped votes thin
+the SUCCESS pool: commits survive while honest >= N/2 and stall past it —
+the sweep records exactly where.
+
+Usage: python tools/run_config4.py [n] [rounds] [n_f_points]
+"""
+
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import json
+import time
+
+import jax
+
+from blockchain_simulator_tpu.models.base import get_protocol
+from blockchain_simulator_tpu.runner import make_sim_fn
+from blockchain_simulator_tpu.utils.config import FaultConfig, SimConfig
+from blockchain_simulator_tpu.utils.sync import force_sync
+
+
+def main() -> None:
+    n = int(_sys.argv[1]) if len(_sys.argv) > 1 else 100_000
+    rounds = int(_sys.argv[2]) if len(_sys.argv) > 2 else 200
+    points = int(_sys.argv[3]) if len(_sys.argv) > 3 else 5
+    f_max = (n - 1) // 3
+    fs = sorted({round(f_max * i / (points - 1)) for i in range(points)})
+    proto = get_protocol("pbft")
+    rows = []
+    for f in fs:
+        cfg = SimConfig(
+            protocol="pbft", n=n, sim_ms=rounds * 50 + 100,
+            pbft_max_rounds=rounds, pbft_max_slots=rounds + 8, pbft_window=8,
+            delivery="stat", model_serialization=False,
+            faults=FaultConfig(n_byzantine=f),
+        )
+        sim = make_sim_fn(cfg)
+        force_sync(sim(jax.random.key(0)))
+        t0 = time.perf_counter()
+        final = force_sync(sim(jax.random.key(1)))
+        wall = time.perf_counter() - t0
+        m = proto.metrics(cfg, final)
+        rows.append({
+            "f": f,
+            "f_frac": round(f / n, 4),
+            "wall_s": round(wall, 3),
+            "rounds_per_s": round(m["blocks_final_all_nodes"] / wall, 1)
+            if wall > 0 else None,
+            **{k: m[k] for k in ("rounds_sent", "blocks_final_all_nodes",
+                                 "block_num_max", "agreement_ok")},
+        })
+        print(json.dumps(rows[-1]), flush=True)
+    out = {
+        "config": "BASELINE-4 pbft byzantine sweep",
+        "backend": jax.default_backend(),
+        "n": n,
+        "rounds": rounds,
+        "quorum_rule": "n2",
+        "schedule": "round fast path",
+        "sweep": rows,
+    }
+    path = _os.path.join(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))), "ARTIFACT_config4.json")
+    with open(path, "w") as f_:
+        json.dump(out, f_, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

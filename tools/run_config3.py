@@ -53,6 +53,10 @@ def main() -> None:
     cfg = SimConfig(
         protocol="paxos", n=n, sim_ms=sim_ms, topology="kregular",
         degree=degree, delivery="stat", model_serialization=False,
+        # clean-fidelity retry windows must cover the full flood + reply
+        # horizon: (gossip_hops + 2) * delay_hi = 10 * 53 = 530 ms at the
+        # defaults (models/paxos.init validates this)
+        paxos_retry_timeout_ms=600,
     )
     proto = get_protocol("paxos")
     n_dev = len(jax.devices())

@@ -122,14 +122,16 @@ def test_indivisible_shard_count_raises():
 
 
 def test_seed_sweep_unsharded():
-    cfg = CFG.with_(n=8, sim_ms=400, pbft_max_rounds=5)
+    # 500 ms window: round 5 (t=250) + ~136 ms block serialization
+    # (default-on) + its prepare/commit waves finalizes at ~410 ms
+    cfg = CFG.with_(n=8, sim_ms=500, pbft_max_rounds=5)
     ms = run_seed_sweep(cfg, seeds=[0, 1, 2])
     assert len(ms) == 3
     assert all(m["blocks_final_all_nodes"] == 5 for m in ms)
 
 
 def test_seed_sweep_sharded_mesh():
-    cfg = CFG.with_(n=16, sim_ms=400, pbft_max_rounds=5)
+    cfg = CFG.with_(n=16, sim_ms=500, pbft_max_rounds=5)
     mesh = make_mesh(n_node_shards=4, n_sweep=2)
     ms = run_seed_sweep(cfg, seeds=[0, 1], mesh=mesh)
     assert len(ms) == 2

@@ -60,10 +60,19 @@ def test_raft_probe():
 
 def test_cli_trace(tmp_path):
     out = tmp_path / "series.npz"
+    # the child must not touch the accelerator: JAX_PLATFORMS=cpu alone is
+    # not enough (the env's sitecustomize forces the axon plugin at the
+    # config level — see conftest.py), and an unhealthy tunnel turns the
+    # axon init attempt into a multi-minute hang; an empty pool-IP list
+    # skips the plugin registration entirely (same trick as bench.py's
+    # CPU fallback)
+    import os
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
     proc = subprocess.run(
         [sys.executable, "-m", "blockchain_simulator_tpu", "--protocol", "pbft",
          "--n", "8", "--sim-ms", "1200", "--trace", str(out)],
-        capture_output=True, text=True, timeout=240,
+        capture_output=True, text=True, timeout=240, env=env,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     m = json.loads(proc.stdout.strip().splitlines()[-1])

@@ -36,6 +36,10 @@ def main() -> None:
     points = int(_sys.argv[3]) if len(_sys.argv) > 3 else 5
     f_max = (n - 1) // 3
     fs = sorted({round(f_max * i / (points - 1)) for i in range(points)})
+    # boundary demonstration: under n2 counting, commits survive while
+    # honest >= N/2 + 1 (pbft-node.cc:248); one past-the-boundary point
+    # (honest = N/2 - 1 < commit quorum) pins the stall
+    fs.append(n // 2 + 1)
     proto = get_protocol("pbft")
     rows = []
     for f in fs:

@@ -45,6 +45,15 @@ Fidelity modes:
   reference would).
 - ``clean``: heartbeats re-arm the election timer (real failure detection) and
   a block commits as soon as acks reach the majority, latched once per round.
+
+Gossip topology (``topology="kregular"``, clean + stat only): the three
+broadcast channels — VOTE_REQ, plain HEARTBEAT, proposal HEARTBEAT — flood
+over a random k-out digraph with a hop TTL (time-monotone value encodings,
+per-channel ``seen`` dedup registers, same overlay as models/paxos.py);
+votes and proposal acks stay direct unicast to the decoded originator, with
+acks generated at flood arrival (the full-mesh short-circuited round trip
+has no meaning over multi-hop paths).  Clean-mode majority counting is
+arrival-time based, so multi-hop ack latency only shifts commit times.
 """
 
 from __future__ import annotations
@@ -57,6 +66,7 @@ from flax import struct
 from blockchain_simulator_tpu.models.base import fault_masks, gated
 from blockchain_simulator_tpu.ops import delay as delay_ops
 from blockchain_simulator_tpu.ops import delivery as dv
+from blockchain_simulator_tpu.ops import topology
 from blockchain_simulator_tpu.ops.ring import ring_pop, ring_push_add, ring_push_max
 from blockchain_simulator_tpu.utils.prng import Channel, chan_key
 
@@ -86,6 +96,27 @@ class RaftState:
     block_tick: jax.Array     # [N, B] commit tick per block at the leader (-1)
     alive: jax.Array          # [N] bool fault mask
     honest: jax.Array         # [N] bool fault mask
+    # gossip (topology="kregular") dedup registers: highest TTL-encoded copy
+    # seen per flooded channel (vote requests / plain heartbeats / proposals);
+    # zeros and unused on the full mesh
+    seen_vreq: jax.Array      # [N]
+    seen_hb: jax.Array        # [N]
+    seen_prop: jax.Array      # [N]
+    # gossip elections: multi-hop flood latency (~hops*delay) spans many
+    # nodes' election deadlines, so votes fragment across a storm of
+    # concurrent candidates; with the reference's permanent single-vote
+    # latch (quirk #6) nobody ever reaches a majority and elections deadlock
+    # at n >~ 100.  Clean-fidelity gossip therefore votes for the NEWEST
+    # election seen — the ``seen_vreq`` dedup register IS the term
+    # comparison (bases are time-monotone and a node only processes strictly
+    # newer ones), so every processed request is granted; a candidate
+    # restarts its count at each fire (reply horizons << election timeouts,
+    # so stale replies drain first — the models/paxos.py temporal-separation
+    # argument).  Stale in-flight grants can still hand majorities to
+    # SEVERAL storm candidates, so leaders also step down on observing a
+    # newer election than their own (real Raft's step-down-on-higher-term)
+    # — ``my_base`` remembers the election a leader won.
+    my_base: jax.Array        # [N] last election base this node fired with
 
 
 @struct.dataclass
@@ -142,6 +173,10 @@ def init(cfg, key=None):
         block_tick=jnp.full((n, b), -1, jnp.int32),
         alive=alive,
         honest=honest,
+        seen_vreq=zi(n),
+        seen_hb=zi(n),
+        seen_prop=zi(n),
+        my_base=zi(n),
     )
     if cfg.delivery == "stat":
         vreq = zi(d, n)
@@ -195,9 +230,45 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
     else:
         vreq_t = vreq_t * am[:, None]
 
+    # ---- gossip decode (topology="kregular"): the three broadcast channels
+    # (VOTE_REQ, plain HEARTBEAT, proposal HEARTBEAT) flood over the k-out
+    # digraph with a hop TTL; replies (votes, proposal acks) stay direct
+    # unicast to the decoded originator — the same overlay as models/paxos.py.
+    # Flood values are time-monotone encodings (dedup by per-channel ``seen``
+    # register): vreq (t+1)*n + cand + 1; plain hb t+1; proposal
+    # (t+1)*(n+1) + leader + 1 (the +1 keeps 0 = empty).  A node processes
+    # each base value once (first sighting) but forwards any strictly better
+    # TTL copy, so a nearly-expired first arrival cannot truncate the flood.
+    gossip = cfg.topology == "kregular"
+    seen_vreq, seen_hb, seen_prop = state.seen_vreq, state.seen_hb, state.seen_prop
+    vreq_fwd = hb_fwd = prop_fwd = None
+    nbrs_loc = None
+    if gossip:
+        h_enc = cfg.gossip_hops + 1
+        nbrs_loc = jnp.take(
+            jnp.asarray(topology.kregular_out_neighbors(n, cfg.degree, cfg.seed)),
+            ids, axis=0,
+        )
+
+        def _decode(arr, seen):
+            base, hops = arr // h_enc, arr % h_enc
+            new = (base > seen // h_enc) & state.alive
+            better = (arr > seen) & state.alive
+            seen = jnp.maximum(seen, arr * better)
+            fwd = (base * h_enc + jnp.maximum(hops - 1, 0)) * (better & (hops > 0))
+            return base * new, seen, fwd
+
+        vreq_t, seen_vreq, vreq_fwd = _decode(vreq_t, seen_vreq)
+        plain_t, seen_hb, hb_fwd = _decode(plain_t, seen_hb)
+        prop_t, seen_prop, prop_fwd = _decode(prop_t, seen_prop)
+
     # ---- heartbeat arrivals (follower side, raft-node.cc:170-193) -----------
     got_hb = (plain_t > 0) | (prop_t > 0)
-    m_value = jnp.where(prop_t > 0, prop_t - 1, state.m_value)
+    if gossip:
+        # proposal value = the leader id riding the flood encoding
+        m_value = jnp.where(prop_t > 0, (prop_t - 1) % (n + 1), state.m_value)
+    else:
+        m_value = jnp.where(prop_t > 0, prop_t - 1, state.m_value)
     if clean:
         # re-arm the election timer: real failure detection
         k_e = chan_key(tkey, Channel.ELECTION)
@@ -213,15 +284,78 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
         # (raft-node.cc:177-178) — one heartbeat pacifies a follower forever
         election_deadline = jnp.where(got_hb, DISARM, state.election_deadline)
 
+    # ---- gossip proposal acks: a follower acks the proposal when the flood
+    # lands (direct unicast to the decoded leader); replaces the full-mesh
+    # short-circuited round trip, which has no meaning over multi-hop paths
+    if gossip:
+        got_prop = prop_t > 0
+        ack_to = jnp.where(got_prop, (prop_t - 1) % (n + 1), n)  # n = drop
+        k_ack = chan_key(tkey, Channel.DELAY_REPLY2)
+
+        def _ack_counts(wire):
+            c = jnp.zeros((n,), jnp.int32).at[ack_to].add(
+                wire.astype(jnp.int32), mode="drop"
+            )
+            if axis is not None:
+                c = jax.lax.psum(c, axis)
+                start = jax.lax.axis_index(axis) * n_loc
+                c = jax.lax.dynamic_slice_in_dim(c, start, n_loc)
+            return c
+
+        def _ack_buckets():
+            mok = _ack_counts(got_prop & state.honest & state.alive)
+            mbad = _ack_counts(got_prop & ~state.honest & state.alive)
+            if drop > 0.0:
+                kd = jax.random.fold_in(k_ack, 0x0D18)
+                mok = jnp.round(delay_ops.binom(
+                    kd, mok, 1.0 - drop, smode)).astype(jnp.int32)
+                mbad = jnp.round(delay_ops.binom(
+                    jax.random.fold_in(kd, 1), mbad, 1.0 - drop,
+                    smode)).astype(jnp.int32)
+            return jnp.stack([
+                delay_ops.sample_bucket_counts(
+                    jax.random.fold_in(k_ack, 1), mok, ow_probs, smode),
+                delay_ops.sample_bucket_counts(
+                    jax.random.fold_in(k_ack, 2), mbad, ow_probs, smode),
+            ])
+
+        both_acks = gated(
+            got_prop.any(), _ack_buckets,
+            jnp.zeros((2, hi - lo, n_loc), jnp.int32), axis,
+        )
+        hb_ok = ring_push_add(hb_ok, t, lo, both_acks[0])
+        hb_bad = ring_push_add(hb_bad, t, lo, both_acks[1])
+
     # ---- vote requests (acceptor side, raft-node.cc:154-167) ---------------
     can_grant = ~state.has_voted & state.alive
+    my_base = state.my_base
     if stat:
-        # vreq_t[i] = max candidate id + 1 seen this tick (the stat broadcast
-        # reaches the sender too — drop the self-request)
-        grant_to = vreq_t - 1  # global candidate id
+        # full mesh: vreq_t[i] = max candidate id + 1 seen this tick (the
+        # stat broadcast reaches the sender too — drop the self-request);
+        # gossip: the candidate id rides the flood encoding
+        grant_to = (vreq_t - 1) % n if gossip else vreq_t - 1
         has_req = (vreq_t > 0) & (grant_to != ids)
-        grant = has_req & can_grant
-        deny = has_req & ~can_grant
+        if gossip:
+            # term-style release: the dedup register admits only strictly
+            # newer elections (see the my_base field comment), so every
+            # processed request is a grant — the permanent latch would
+            # deadlock the storm
+            grant = has_req & state.alive
+            # granting a vote resets the election timeout (standard Raft):
+            # during the candidacy storm every node keeps re-arming, so no
+            # timer fires into the winner's first heartbeat window and the
+            # post-storm leader is not spuriously deposed
+            k_gr = chan_key(tkey, Channel.ELECTION + 300)
+            if axis is not None:
+                k_gr = jax.random.fold_in(k_gr, jax.lax.axis_index(axis))
+            rearm_gr = t + jax.random.randint(
+                k_gr, (n_loc,), cfg.raft_election_lo_ms,
+                cfg.raft_election_hi_ms, dtype=jnp.int32,
+            )
+            election_deadline = jnp.where(grant, rearm_gr, election_deadline)
+        else:
+            grant = has_req & can_grant
+        deny = has_req & ~grant
         has_voted = state.has_voted | grant
         # Byzantine receivers flip their replies (grant<->deny on the wire)
         ok_wire = (grant & state.honest) | (deny & ~state.honest)
@@ -314,12 +448,38 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
     # loser: majority denied — release the vote latch and retry on the timer
     has_voted = has_voted & ~lose
 
+    # ---- gossip: leader step-down on a newer election (see my_base) ---------
+    if gossip:
+        newest = seen_vreq // h_enc
+        resign = is_leader & (newest > state.my_base) & state.alive
+        is_leader = is_leader & ~resign
+        next_hb = jnp.where(resign, DISARM, next_hb)
+        proposal_tick = jnp.where(resign, DISARM, proposal_tick)
+        # back to follower: re-arm the election timer (clean fidelity —
+        # gossip requires it) so the node can detect the new leader failing
+        k_rs = chan_key(tkey, Channel.ELECTION + 200)
+        if axis is not None:
+            k_rs = jax.random.fold_in(k_rs, jax.lax.axis_index(axis))
+        rearm_rs = t + jax.random.randint(
+            k_rs, (n_loc,), cfg.raft_election_lo_ms, cfg.raft_election_hi_ms,
+            dtype=jnp.int32,
+        )
+        election_deadline = jnp.where(resign, rearm_rs, election_deadline)
+    else:
+        resign = jnp.zeros((n_loc,), bool)
+    # a resigned leader abandons its open ack window: in-flight acks keep
+    # arriving at the ex-leader (unicast), and without this a later
+    # re-election could latch a phantom commit from pre-resignation acks
+    hb_succ_in = jnp.where(resign, 0, state.hb_succ)
+    hb_cnt_in = jnp.where(resign, 0, state.hb_cnt)
+    hb_open_in = state.hb_open & ~resign
+
     # ---- proposal acks (leader side, raft-node.cc:234-251) ------------------
-    hs = state.hb_succ + hbok_t
-    hc = state.hb_cnt + hbtot_t
+    hs = hb_succ_in + hbok_t
+    hc = hb_cnt_in + hbtot_t
     if clean:
-        commit = state.hb_open & (hs + 1 >= cfg.majority_need) & is_leader
-        hb_open = state.hb_open & ~commit
+        commit = hb_open_in & (hs + 1 >= cfg.majority_need) & is_leader
+        hb_open = hb_open_in & ~commit
         hb_succ, hb_cnt = hs, hc
     else:
         # reference: the check runs only at exactly N-1 responses in
@@ -327,7 +487,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
         commit = done & (hs + 1 >= cfg.majority_need)
         hb_succ = jnp.where(done, 0, hs)
         hb_cnt = jnp.where(done, 0, hc)
-        hb_open = state.hb_open
+        hb_open = hb_open_in
     blk = jnp.clip(state.block_num, 0, cfg.raft_max_blocks - 1)
     block_tick = jnp.where(
         (jax.nn.one_hot(blk, cfg.raft_max_blocks, dtype=bool)
@@ -336,8 +496,14 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
         state.block_tick,
     )
     block_num = state.block_num + commit
-    # blockNum >= 50 cancels the heartbeat (raft-node.cc:248-251)
-    next_hb = jnp.where(block_num >= cfg.raft_max_blocks, DISARM, next_hb)
+    # blockNum >= 50 cancels the heartbeat (raft-node.cc:248-251).  Gossip
+    # divergence: completion must NOT silence the failure detector — with
+    # term-style vote release, heartbeat silence triggers a fresh election
+    # whose winner re-replicates from scratch (per-leader counters, no
+    # shared log); the completed leader keeps the 4-byte control heartbeat
+    # and simply stops proposing (add_change_value already cleared).
+    if not gossip:
+        next_hb = jnp.where(block_num >= cfg.raft_max_blocks, DISARM, next_hb)
 
     # ---- timer: sendVote (raft-node.cc:392-401) -----------------------------
     fire = (
@@ -347,6 +513,11 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
         & state.alive
     )
     has_voted = has_voted | fire  # self-vote latch
+    if gossip:
+        # fresh election: restart the reply count (stale replies from the
+        # previous election drained long ago — reply horizon << timeout)
+        vote_success = jnp.where(fire, 0, vote_success)
+        vote_failed = jnp.where(fire, 0, vote_failed)
     k_e2 = chan_key(tkey, Channel.ELECTION + 100)
     if axis is not None:
         k_e2 = jax.random.fold_in(k_e2, jax.lax.axis_index(axis))
@@ -357,7 +528,23 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
     election_deadline = jnp.where(fire, rearm2, election_deadline)
     elections = state.elections + fire
     k_vq = chan_key(tkey, Channel.DELAY_BCAST)
-    if stat:
+    if gossip:
+        # flood origin: full TTL, marked seen so the self-loop copy is inert
+        base_v = ((jnp.int32(t) + 1) * n + ids + 1) * fire.astype(jnp.int32)
+        origin_v = (base_v * h_enc + cfg.gossip_hops) * (base_v > 0)
+        seen_vreq = jnp.maximum(seen_vreq, origin_v)
+        # the candidate backs its own (newest) election
+        my_base = jnp.maximum(my_base, base_v)
+        out_v = jnp.maximum(origin_v, vreq_fwd)
+        vq_contrib = gated(
+            (out_v > 0).any(),
+            lambda: dv.gossip_fwd(k_vq, out_v[:, None], nbrs_loc, n, lo, hi,
+                                  drop, axis=axis)[:, :, 0],
+            zeros_flat,
+            axis,
+        )
+        vreq = ring_push_max(vreq, t, lo, vq_contrib)
+    elif stat:
         vq_contrib = gated(
             fire.any(),
             lambda: dv.bcast_value_max_stat(
@@ -384,10 +571,17 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
     # setProposal fires exactly once (raft-node.cc:216,431-433) — round==50
     # clears add_change_value for good, so the trigger must not re-fire
     set_prop = (jnp.int32(t) >= proposal_tick) & (proposal_tick != DISARM)
-    add_change_value = state.add_change_value | set_prop
+    add_change_value = (state.add_change_value | set_prop) & ~resign
     proposal_tick = jnp.where(set_prop, DISARM, proposal_tick)
     prop_send = hb_fire & add_change_value
-    plain_send = hb_fire & ~add_change_value
+    # Full mesh: either/or, like the reference (raft-node.cc:405-433).
+    # Gossip: the leader ALWAYS floods the 4-byte plain heartbeat — a 20 KB
+    # proposal store-and-forwards ~hops*(delay+ser) (~460 ms at defaults),
+    # far beyond the 150-300 ms election window, so using the block channel
+    # as the failure detector deposes a healthy leader every proposal phase;
+    # separating the control heartbeat from block dissemination is the
+    # documented gossip divergence.
+    plain_send = hb_fire if gossip else (hb_fire & ~add_change_value)
     next_hb = jnp.where(hb_fire, next_hb + cfg.raft_heartbeat_ms, next_hb)
     # SendTX: round++; at round==50 stop adding proposals (raft-node.cc:361-365)
     round_ = state.round + prop_send
@@ -401,7 +595,40 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
 
     ser = cfg.serialization_ticks(cfg.raft_block_bytes)
     k_hb = chan_key(tkey, Channel.DELAY_BCAST2)
-    if stat:
+    if gossip:
+        # plain heartbeats: tiny control messages, flooded with the tick as
+        # the monotone base (concurrent leaders dedup to one — got_hb only
+        # pacifies timers); proposals carry the 20 KB block, so every hop
+        # re-serializes (store-and-forward), hence ser on each leg
+        base_h = (jnp.int32(t) + 1) * plain_send.astype(jnp.int32)
+        origin_h = (base_h * h_enc + cfg.gossip_hops) * (base_h > 0)
+        seen_hb = jnp.maximum(seen_hb, origin_h)
+        out_h = jnp.maximum(origin_h, hb_fwd)
+        plain_contrib = gated(
+            (out_h > 0).any(),
+            lambda: dv.gossip_fwd(
+                jax.random.fold_in(k_hb, 2), out_h[:, None], nbrs_loc, n, lo,
+                hi, drop, axis=axis)[:, :, 0],
+            zeros_flat,
+            axis,
+        )
+        hb_plain = ring_push_max(hb_plain, t, lo, plain_contrib)
+        base_p = (
+            (jnp.int32(t) + 1) * (n + 1) + ids + 1
+        ) * prop_send.astype(jnp.int32)
+        origin_p = (base_p * h_enc + cfg.gossip_hops) * (base_p > 0)
+        seen_prop = jnp.maximum(seen_prop, origin_p)
+        out_p = jnp.maximum(origin_p, prop_fwd)
+        prop_contrib = gated(
+            (out_p > 0).any(),
+            lambda: dv.gossip_fwd(
+                jax.random.fold_in(k_hb, 3), out_p[:, None], nbrs_loc, n, lo,
+                hi, drop, axis=axis)[:, :, 0],
+            zeros_flat,
+            axis,
+        )
+        hb_prop = ring_push_max(hb_prop, t, lo + ser, prop_contrib)
+    elif stat:
         plain_contrib = gated(
             plain_send.any(),
             lambda: dv.bcast_counts_stat(
@@ -441,19 +668,23 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
             zeros_flat,
             axis,
         )
-    hb_plain = ring_push_add(hb_plain, t, lo, plain_contrib)
-    hb_prop = ring_push_max(hb_prop, t, lo + ser, prop_contrib)
+    if not gossip:
+        hb_plain = ring_push_add(hb_plain, t, lo, plain_contrib)
+        hb_prop = ring_push_max(hb_prop, t, lo + ser, prop_contrib)
 
     # proposal acks: follower state never affects the SUCCESS reply
     # (raft-node.cc:170-193), so the round trip is short-circuited; Byzantine
     # followers flip to FAILED.  The SUCCESS (honest) and FAILED (Byzantine)
     # channels cover *disjoint* peer sets, so their independent delay draws
     # cover disjoint edges — each ack lands in exactly one channel at one tick,
-    # and the leader's total count is their sum.
+    # and the leader's total count is their sum.  (Gossip acks are generated
+    # at flood arrival instead — see the gossip block above.)
     k_rt = chan_key(tkey, Channel.DELAY_ROUNDTRIP)
     voters = state.alive & state.honest
     liars = state.alive & ~state.honest
-    if stat:
+    if gossip:
+        pass
+    elif stat:
         n_voters = _psum_scalar(voters.astype(jnp.int32).sum(), axis)
         n_liars = _psum_scalar(liars.astype(jnp.int32).sum(), axis)
         ok_counts = gated(
@@ -489,8 +720,9 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
             zeros_rt,
             axis,
         )
-    hb_ok = ring_push_add(hb_ok, t, rt_lo + ser, ok_counts)
-    hb_bad = ring_push_add(hb_bad, t, rt_lo + ser, bad_counts)
+    if not gossip:
+        hb_ok = ring_push_add(hb_ok, t, rt_lo + ser, ok_counts)
+        hb_bad = ring_push_add(hb_bad, t, rt_lo + ser, bad_counts)
 
     state = state.replace(
         is_leader=is_leader,
@@ -510,6 +742,10 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
         leader_tick=leader_tick,
         elections=elections,
         block_tick=block_tick,
+        seen_vreq=seen_vreq,
+        seen_hb=seen_hb,
+        seen_prop=seen_prop,
+        my_base=my_base,
     )
     bufs = RaftBufs(
         vreq=vreq, vres_ok=vres_ok, vres_no=vres_no, hb_plain=hb_plain,

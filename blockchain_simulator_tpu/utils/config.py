@@ -260,18 +260,33 @@ class SimConfig:
                     f"simulation window [0, {self.sim_ms})"
                 )
         if self.topology == "kregular":
-            if self.protocol not in ("paxos", "pbft"):
+            if self.protocol not in ("paxos", "pbft", "raft"):
                 raise NotImplementedError(
                     "gossip topology is implemented for paxos (BASELINE "
-                    "config 3: request floods) and pbft (block-dissemination "
-                    "floods, SURVEY.md §5 scaling answer); raft/mixed use "
-                    "the full mesh"
+                    "config 3: request floods), pbft (block-dissemination "
+                    "floods) and raft (vote/heartbeat floods with direct "
+                    "unicast replies); the mixed shard sim keeps full-mesh "
+                    "raft inside its (small) shards by design"
                 )
             if self.fidelity != "clean":
                 raise ValueError(
                     "reference fidelity is defined on the full mesh only "
                     "(the reference has no gossip relay)"
                 )
+            if self.protocol == "raft":
+                if self.delivery != "stat":
+                    raise ValueError(
+                        "raft gossip rides the stat-mode value channels; "
+                        "use delivery='stat' with topology='kregular'"
+                    )
+                # flood values encode (tick+1)*(n+1) + id, TTL-scaled by
+                # gossip_hops+1 — must fit int32
+                if (self.sim_ms + 1) * (self.n + 1) * (self.gossip_hops + 1) >= 2**31:
+                    raise ValueError(
+                        "raft gossip encoding (sim_ms+1)*(n+1)*(gossip_hops+1) "
+                        "overflows int32 at this size; reduce sim_ms, n, or "
+                        "gossip_hops"
+                    )
 
     # --- derived quantities (plain python; all static under jit) ------------
     @property

@@ -73,9 +73,13 @@ def test_gossip_validation():
         from blockchain_simulator_tpu.models import paxos
 
         paxos.init(GCFG.with_(paxos_retry_timeout_ms=200))
-    # gossip floods exist for paxos (requests) and pbft (blocks); not raft
-    with pytest.raises(NotImplementedError):
-        SimConfig(protocol="raft", topology="kregular")
+    # gossip floods exist for paxos (requests), pbft (blocks) and raft
+    # (votes/heartbeats, stat channels only); the mixed shard sim keeps
+    # full-mesh raft inside its small shards
+    with pytest.raises(ValueError, match="stat"):
+        SimConfig(protocol="raft", topology="kregular")  # delivery defaults to edge
+    with pytest.raises(NotImplementedError, match="mixed"):
+        SimConfig(protocol="mixed", topology="kregular")
     # reference fidelity has no gossip relay
     with pytest.raises(ValueError, match="full mesh"):
         SimConfig(protocol="paxos", topology="kregular", fidelity="reference")
@@ -141,3 +145,71 @@ def test_gossip_pbft_requires_exact_window():
 
     with _pytest.raises(ValueError, match="exact vote-table mode"):
         pbft.init(PBFT_GCFG.with_(pbft_window=8, pbft_max_slots=64))
+
+
+# --- raft gossip (VOTE_REQ / heartbeat floods, direct unicast replies) ------
+
+
+RAFT_GCFG = SimConfig(
+    protocol="raft", n=128, sim_ms=6000, topology="kregular",
+    degree=8, gossip_hops=8, delivery="stat",
+)
+
+
+def test_gossip_raft_elects_and_replicates():
+    m = run_simulation(RAFT_GCFG)
+    assert m["n_leaders"] == 1
+    # multi-hop ack latency shifts commit times but replication completes:
+    # 50 rounds proposed, commits within a couple of rounds of the full mesh
+    assert m["rounds"] == 50
+    assert m["blocks"] >= 45
+    assert m["agreement_ok"]
+
+
+def test_gossip_raft_milestones_match_full_mesh():
+    mg = run_simulation(RAFT_GCFG)
+    mf = run_simulation(RAFT_GCFG.with_(topology="full"))
+    assert mg["n_leaders"] == mf["n_leaders"] == 1
+    assert mg["rounds"] == mf["rounds"] == 50
+    assert abs(mg["blocks"] - mf["blocks"]) <= 2
+    # both detect the leader within the first election windows
+    assert mg["leader_elected_ms"] < 1000
+    assert mf["leader_elected_ms"] < 1000
+
+
+def test_gossip_raft_crash_minority():
+    cfg = RAFT_GCFG.with_(faults=FaultConfig(n_crashed=32))
+    m = run_simulation(cfg)
+    assert m["n_leaders"] >= 1
+    assert m["blocks"] >= 40
+    assert m["agreement_ok"]
+
+
+def test_gossip_raft_serialization_off_reaches_50():
+    # without the 54 ms per-hop block serialization the ack pipeline keeps up
+    m = run_simulation(RAFT_GCFG.with_(model_serialization=False))
+    assert m["n_leaders"] == 1
+    assert m["blocks"] == 50
+    assert m["agreement_ok"]
+
+
+def test_gossip_raft_requires_stat_and_clean():
+    with pytest.raises(ValueError, match="stat"):
+        SimConfig(protocol="raft", n=64, topology="kregular", delivery="edge")
+    with pytest.raises(ValueError, match="full mesh"):
+        SimConfig(protocol="raft", n=64, topology="kregular", delivery="stat",
+                  fidelity="reference")
+    with pytest.raises(NotImplementedError, match="mixed"):
+        SimConfig(protocol="mixed", n=64, topology="kregular")
+
+
+def test_gossip_raft_sharded_matches_unsharded():
+    from blockchain_simulator_tpu.parallel.mesh import make_mesh
+    from blockchain_simulator_tpu.parallel.shard import run_sharded
+
+    cfg = RAFT_GCFG.with_(n=64, sim_ms=4000)
+    m_s = run_sharded(cfg, make_mesh(n_node_shards=4))
+    m_u = run_simulation(cfg)
+    assert m_s["n_leaders"] == m_u["n_leaders"] == 1
+    assert abs(m_s["blocks"] - m_u["blocks"]) <= 3
+    assert m_s["agreement_ok"] and m_u["agreement_ok"]

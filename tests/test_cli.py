@@ -61,3 +61,33 @@ def test_sharded_flag(capsys):
 def test_bad_protocol_rejected():
     with pytest.raises(SystemExit):
         build_parser().parse_args(["--protocol", "pow"])
+
+
+def test_cpp_fidelity_flags(capsys):
+    # 2600 ms window: echoed 50 KB blocks occupy the queued links too, so
+    # votes trail reflected blocks and the per-round backlog is ~2x the
+    # queued-only case — the combination the two flags exist to model
+    (m,) = run_cli(capsys, "--protocol", "pbft", "--engine", "cpp",
+                   "--sim-ms", "2600", "--pbft-rounds", "10",
+                   "--echo-back", "--queued-links")
+    assert m["blocks_final_all_nodes"] == 10
+    assert m["delivered_msgs"] > 0
+
+
+def test_cpp_only_flags_rejected_on_jax_engine(capsys):
+    assert main(["--protocol", "pbft", "--echo-back"]) == 2
+    assert main(["--protocol", "pbft", "--queued-links"]) == 2
+
+
+def test_paxos_client_flag(capsys):
+    (m,) = run_cli(capsys, "--protocol", "paxos", "--engine", "cpp",
+                   "--sim-ms", "6000", "--paxos-client", "2", "2000")
+    assert m["agreement_ok"]
+
+
+def test_raft_gossip_cli(capsys):
+    (m,) = run_cli(capsys, "--protocol", "raft", "--n", "64",
+                   "--sim-ms", "3000", "--topology", "kregular",
+                   "--delivery", "stat", "--degree", "8")
+    assert m["n_leaders"] == 1
+    assert m["agreement_ok"]

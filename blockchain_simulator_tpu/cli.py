@@ -111,8 +111,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pbft-window", type=int, default=d.pbft_window,
                    help="live vote-state window W (0 = exact full table); "
                         "the O(N*W) memory lever at 100k nodes")
+    p.add_argument("--pbft-tx-speed", type=int, default=d.pbft_tx_speed,
+                   help="offered tx/s; with --pbft-tx-size sets the block "
+                        "size (pbft-node.cc:104-105; 300 is the sustainable "
+                        "rate on the 3 Mbps link the serialization-aware "
+                        "round path needs, models/pbft_round.py)")
+    p.add_argument("--pbft-tx-size", type=int, default=d.pbft_tx_size)
     p.add_argument("--raft-heartbeat-ms", type=int, default=d.raft_heartbeat_ms)
     p.add_argument("--raft-blocks", type=int, default=d.raft_max_blocks)
+    p.add_argument("--raft-tx-speed", type=int, default=d.raft_tx_speed)
+    p.add_argument("--raft-tx-size", type=int, default=d.raft_tx_size)
     p.add_argument("--paxos-proposers", type=int, default=d.paxos_n_proposers)
     p.add_argument("--mixed-shards", type=int, default=d.mixed_shards,
                    help="raft shard count for --protocol mixed")
@@ -154,8 +162,12 @@ def config_from_args(args) -> SimConfig:
         pbft_max_rounds=args.pbft_rounds,
         pbft_max_slots=args.pbft_max_slots,
         pbft_window=args.pbft_window,
+        pbft_tx_speed=args.pbft_tx_speed,
+        pbft_tx_size=args.pbft_tx_size,
         raft_heartbeat_ms=args.raft_heartbeat_ms,
         raft_max_blocks=args.raft_blocks,
+        raft_tx_speed=args.raft_tx_speed,
+        raft_tx_size=args.raft_tx_size,
         paxos_n_proposers=args.paxos_proposers,
         mixed_shards=args.mixed_shards,
         faults=FaultConfig(
@@ -170,14 +182,30 @@ def config_from_args(args) -> SimConfig:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    cfg = config_from_args(args)
+    try:
+        cfg = config_from_args(args)
+    except ValueError as e:
+        # SimConfig validation (e.g. --paxos-client lane/ms range) — same
+        # clean-UX contract as the flag checks below: message + exit code 2
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     seeds = args.seeds if args.seeds is not None else [args.seed]
 
-    if args.engine != "cpp" and (args.echo_back or args.queued_links):
-        print("error: --echo-back/--queued-links require --engine cpp (the "
-              "tensorized backends model neither; see SimConfig docs)",
+    if args.engine != "cpp" and args.echo_back:
+        print("error: --echo-back requires --engine cpp (the tensorized "
+              "backends design the echo away; see SimConfig docs)",
               file=sys.stderr)
         return 2
+    if args.engine != "cpp" and args.queued_links:
+        # pbft (serial-pipe registers) and paxos (ser = 0) run on the
+        # tensorized backends; anything else gets the runner's message
+        from blockchain_simulator_tpu.runner import _reject_cpp_only
+
+        try:
+            _reject_cpp_only(cfg)
+        except (ValueError, NotImplementedError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
 
     if args.engine == "cpp":
         if args.shards > 1:

@@ -36,8 +36,26 @@ Eligibility (checked statically from the config):
 - no per-message drops (with drops, leader belief can diverge between nodes
   and rounds stop being single-proposer);
 - no byz_forge flood (targets the exact-window tick machine);
-- no serialization delay, and the message horizon must fit inside one block
-  interval (max arrival offset < pbft_block_interval_ms), so rounds close.
+- the message horizon (including the constant block-serialization latency
+  when modeled) must fit inside one block interval:
+  ``ser + max_arrival_offset < pbft_block_interval_ms``, so rounds close.
+
+Serialization (model_serialization=True) is a CONSTANT per-block offset in
+the tick engine — only the PRE_PREPARE push carries it (pbft.py step:
+``ring_push_max(pp, t, lo + ser, ...)``; votes/commits are 4-byte packets) —
+so here it shifts the whole round wave rigidly by ``ser`` ticks: arrivals at
+``t0 + ser + d_j``, commit sends at ``t0 + ser + d_j + rt``, commits landing
+at most ``ser + max_arrival_offset`` after the block tick.  At the reference
+default timing (50 KB blocks on 3 Mbps links -> ser = 134 ticks > the 50-tick
+interval) rounds overlap and this path refuses.  Raising the interval alone
+cannot fix that: the block size scales with the interval (num = tx_speed /
+(1000/timeout), pbft-node.cc:377), and the reference's 1000 tx/s x 1 KB
+offered load (8 Mbit/s) exceeds its own 3 Mbps link — the very overload that
+makes its queues grow without bound (tests/test_fidelity.py).  A SUSTAINABLE
+operating point (e.g. tx_speed=300 -> 2.4 Mbit/s, 80% utilization) with the
+interval past ser + horizon (e.g. 200 ms -> ser = 160) is eligible, with
+per-round cost identical to the serialization-free config (the offset is
+arithmetic, not extra work).
 
 Reference anchors: the round cadence being reproduced is SendBlock's 50 ms
 self-rescheduling loop (pbft-node.cc:372-411); thresholds pbft-node.cc:231,
@@ -92,14 +110,15 @@ def max_arrival_offset(cfg) -> int:
 
 
 def eligible(cfg) -> bool:
+    ser = cfg.serialization_ticks(cfg.pbft_block_bytes)
     return (
         cfg.protocol == "pbft"
         and cfg.topology == "full"
         and cfg.delivery == "stat"
         and cfg.faults.drop_prob == 0.0
         and not cfg.faults.byz_forge
-        and cfg.serialization_ticks(cfg.pbft_block_bytes) == 0
-        and max_arrival_offset(cfg) < cfg.pbft_block_interval_ms
+        and not cfg.queued_links  # serial-pipe backlog is cross-round state
+        and ser + max_arrival_offset(cfg) < cfg.pbft_block_interval_ms
     )
 
 
@@ -185,6 +204,9 @@ def step_round(cfg, state: PbftRoundState, r, key):
     smode = cfg.eff_stat_sampler
     ow_probs = delay_ops.uniform_probs(lo, hi)
     rt_probs = delay_ops.roundtrip_probs(lo, hi)
+    # constant block-serialization offset: the tick engine pushes the
+    # PRE_PREPARE at lo + ser (pbft.py), rigidly shifting the whole wave
+    ser = cfg.serialization_ticks(cfg.pbft_block_bytes)
     t0 = r * bt
     n_loc = state.v.shape[0]
     ids = _global_ids(n_loc, axis)
@@ -223,11 +245,11 @@ def step_round(cfg, state: PbftRoundState, r, key):
     leader = jnp.where(any_trigger, new_leader, state.leader)
 
     # ---- B. PRE_PREPARE arrivals + PREPARE round trips ----------------------
-    # per-receiver arrival offset d_j ~ U{lo..hi-1}; proposer excluded
+    # per-receiver arrival offset ser + d_j, d_j ~ U{lo..hi-1}; proposer excluded
     t_end = jnp.int32(cfg.ticks)  # arrivals at tick >= t_end never land
     k_pp = chan_key(tkey, Channel.DELAY_BCAST2)
     d_j = jax.random.randint(_shard_key(k_pp, axis), (n_loc,), lo, hi, jnp.int32)
-    recv = active & state.alive & ~send & (t0 + d_j < t_end)
+    recv = active & state.alive & ~send & (t0 + ser + d_j < t_end)
     # every receiver broadcasts PREPARE on arrival; honest alive peers reply
     # SUCCESS (short-circuited round trip, pbft-node.cc:212-221)
     voters = state.alive & state.honest
@@ -236,17 +258,17 @@ def step_round(cfg, state: PbftRoundState, r, key):
     m_replies = jnp.where(recv, n_voters - voters.astype(jnp.int32), 0)
     rt_counts = delay_ops.sample_bucket_counts(
         _shard_key(k_rt, axis), m_replies, rt_probs, smode
-    )  # [B2, N] reply counts, bucket k -> tick t0 + d_j + rt_lo + k
-    rt_land = (t0 + d_j[None, :] + rt_lo + jnp.arange(b2)[:, None]) < t_end
+    )  # [B2, N] reply counts, bucket k -> tick t0 + ser + d_j + rt_lo + k
+    rt_land = (t0 + ser + d_j[None, :] + rt_lo + jnp.arange(b2)[:, None]) < t_end
     rt_counts = rt_counts * rt_land.astype(jnp.int32)
     crossed_p, _, _ = _crossing_loop(rt_counts, cfg.pbft_prepare_need, clean)
     commit_send = crossed_p & (state.alive & state.honest)[None, :]  # [B2, N]
 
     # ---- C. COMMIT waves -> finality ---------------------------------------
-    # sender j's k-th crossing happens at offset o = d_j + rt_lo + k; group
-    # send counts by absolute offset o in [lo+rt_lo, (hi-1)+rt_lo+B2-1]
+    # sender j's k-th crossing happens at offset o = ser + d_j + rt_lo + k;
+    # group send counts by absolute offset o in [ser+lo+rt_lo, ser+(hi-1)+rt_lo+B2-1]
     w_send = b1 + b2 - 1  # distinct send offsets
-    off_base = lo + rt_lo
+    off_base = ser + lo + rt_lo
     # one-hot of d_j over b1 (static small loop)
     send_at = []  # per offset: [N] 0/1 this node sends a commit then
     for o in range(w_send):
@@ -259,17 +281,22 @@ def step_round(cfg, state: PbftRoundState, r, key):
     send_at = jnp.stack(send_at)  # [w_send, N]
     totals = _psum(send_at.sum(axis=1), axis)  # [w_send] global commit senders
     # receiver m hears, per send offset o, totals[o] - own sends at o,
-    # spread multinomially over the one-way buckets
+    # spread multinomially over the one-way buckets.  One batched [W_send, N]
+    # chain instead of W_send independent [N] chains: identical multinomial
+    # statistics (sample_bucket_counts is elementwise over its leading
+    # shape), ~W_send fewer PRNG/elementwise dispatches per round — the
+    # dominant cost of a round step on the CPU fallback path.
     k_cm = chan_key(tkey, Channel.DELAY_BCAST)
     w_arr = w_send + b1 - 1
-    arrivals = jnp.zeros((w_arr, n_loc), jnp.int32)
+    m_all = jnp.where(state.alive[None, :], totals[:, None] - send_at, 0)
+    cnt_all = delay_ops.sample_bucket_counts(
+        _shard_key(k_cm, axis), m_all, ow_probs, smode
+    )  # [b1, w_send, N]
+    rows = [jnp.zeros((n_loc,), jnp.int32) for _ in range(w_arr)]
     for o in range(w_send):
-        m_o = jnp.where(state.alive, totals[o] - send_at[o], 0)
-        cnt_o = delay_ops.sample_bucket_counts(
-            _shard_key(jax.random.fold_in(k_cm, o), axis), m_o, ow_probs, smode
-        )  # [b1, N]
         for e in range(b1):
-            arrivals = arrivals.at[o + e].add(cnt_o[e])
+            rows[o + e] = rows[o + e] + cnt_all[e, o]
+    arrivals = jnp.stack(rows)
     arr_land = (t0 + off_base + lo + jnp.arange(w_arr)) < t_end  # [w_arr]
     arrivals = arrivals * arr_land.astype(jnp.int32)[:, None]
     crossed_c, n_cross_c, _ = _crossing_loop(

@@ -125,7 +125,61 @@ def test_auto_resolution():
     assert not use_round_schedule(small)   # n < 4096 -> tick
     assert use_round_schedule(big)
     assert not use_round_schedule(dropped)     # ineligible -> tick
-    assert not use_round_schedule(serialized)  # waves span rounds -> tick
+    # at the 50 ms reference interval, ser=134 > interval: waves span rounds
+    assert not use_round_schedule(serialized)
+    # raising the interval alone CANNOT help: the reference's block size
+    # scales with the interval (num = tx_speed/(1000/timeout),
+    # pbft-node.cc:377), and at 1000 tx/s x 1 KB the offered load (8 Mbit/s)
+    # exceeds the 3 Mbps link, so ser grows faster than the interval
+    assert not use_round_schedule(
+        serialized.with_(pbft_block_interval_ms=200, sim_ms=8000))
+    # a sustainable tx rate (300 tx/s = 2.4 Mbit/s < 3 Mbps) with the interval
+    # past ser + horizon closes the rounds again: ser=160, offset<=32, <200
+    ser_wide = serialized.with_(pbft_block_interval_ms=200, pbft_tx_speed=300,
+                                sim_ms=8000)
+    assert use_round_schedule(ser_wide)
+
+
+def test_serialization_offset_matches_tick_engine():
+    # Constant block-serialization latency (model_serialization=True) with the
+    # interval widened past ser + horizon: the fast path must shift the whole
+    # wave by ser and reproduce the tick engine's milestones AND per-slot
+    # finality ticks (same +/-1 tail-jitter contract as the ser=0 case).
+    import numpy as np
+
+    from blockchain_simulator_tpu.runner import final_state
+
+    kw = dict(protocol="pbft", n=64, sim_ms=4200, delivery="stat",
+              model_serialization=True, pbft_block_interval_ms=200,
+              pbft_tx_speed=300)
+    ser = SimConfig(**kw).serialization_ticks(SimConfig(**kw).pbft_block_bytes)
+    assert ser == 160  # 60 KB at 3 Mbps (blockchain-simulator.cc:22-24)
+    tick, rnd = both(kw)
+    for k in MILESTONES:
+        assert rnd[k] == tick[k], k
+    # commits land ser later than the propose tick: ttf must exceed ser
+    assert rnd["mean_time_to_finality_ms"] > ser
+    assert abs(rnd["mean_time_to_finality_ms"] - tick["mean_time_to_finality_ms"]) < 3.0
+    st_t = final_state(SimConfig(**kw, schedule="tick"))
+    st_r = final_state(SimConfig(**kw, schedule="round"))
+    np.testing.assert_array_equal(st_r.slot_commits, st_t.slot_commits)
+    np.testing.assert_array_equal(st_r.slot_propose_tick, st_t.slot_propose_tick)
+    ct_t = np.asarray(st_t.slot_commit_tick)
+    ct_r = np.asarray(st_r.slot_commit_tick)
+    done = np.asarray(st_t.slot_commits) > 0
+    assert done.any()
+    assert int(np.abs(ct_t - ct_r)[done].max()) <= 1
+
+
+def test_serialization_truncated_wave_matches():
+    # window end falls INSIDE the ser-shifted wave (block tick 4000, wave
+    # spans [4166, 4192]): both engines must truncate identically
+    kw = dict(protocol="pbft", n=64, sim_ms=4180, delivery="stat",
+              model_serialization=True, pbft_block_interval_ms=200,
+              pbft_tx_speed=300, pbft_max_rounds=60)
+    tick, rnd = both(kw)
+    for k in MILESTONES:
+        assert rnd[k] == tick[k], k
 
 
 def test_exact_sampler_round_mode():

@@ -200,8 +200,12 @@ class Sim {
       // the serial (from, to) link; the link is reserved at that moment — in
       // link-arrival order, not send-call order (two sends whose scheduling
       // draws invert must transmit in arrival order) — so the reservation
-      // runs as its own event (kind 2 in run_loop)
-      q.push(Event{now + (delay() - cfg.link_prop), seq++, to, 2, 0, mm});
+      // runs as its own event (kind 2 in run_loop).  The scheduling term is
+      // delay() - link_prop; one_way_range on the Python side guarantees
+      // delay_lo >= link_prop, but clamp to 0 so no config path can ever
+      // enqueue an event in the past and walk sim.now backwards (ADVICE r4)
+      q.push(Event{now + std::max(delay() - cfg.link_prop, 0), seq++, to, 2,
+                   0, mm});
       return;
     }
     schedule_msg(to, mm, delay() + extra);

@@ -91,6 +91,22 @@ class PbftState:
     # gossip (topology="kregular") dedup state; zeros on the full mesh
     seen_pp: jax.Array       # [N, W] highest TTL-encoded PRE_PREPARE seen
     seen_vc: jax.Array       # [N] highest TTL-encoded VIEW_CHANGE seen
+    # queued-link transport registers (cfg.queued_links; [N,1] dummies off).
+    # ns-3 models each directed link as a serial 3 Mbps pipe
+    # (blockchain-simulator.cc:22-24): a block transmits when the link is
+    # free, occupies it for its serialization time, then propagates — blocks
+    # depart every 50 ms but serialize ~136 ms, so per-link queues grow
+    # ~86 ms/round (engine.cpp:198-215 is the C++ twin).  Blocks only ever
+    # flow from the current leader, so the busy state is per DESTINATION —
+    # a [N] tensor, not [N,N]; the registers reset on a view change (a
+    # first-time leader's links are vote-only, hence free, in both engines;
+    # divergence only if a leader is RE-elected, which takes N rotations).
+    # Delivery offsets grow without bound, so queued blocks bypass the ring
+    # into a per-destination FIFO of (arrival tick, slot value) pairs.
+    link_busy: jax.Array     # [N] tick until which (leader -> j) is busy
+    ppq_tick: jax.Array      # [N, Q] queued-block arrival ticks (_NEVER free)
+    ppq_val: jax.Array       # [N, Q] queued-block slot+1 values
+    ppq_w: jax.Array         # [N] FIFO write pointer
     # --- per-slot accumulators (GLOBAL_FIELDS; per-shard partials) ----------
     slot_commits: jax.Array      # [S] nodes that finalized slot s (first time)
     slot_commit_tick: jax.Array  # [S] last finalization tick, -1 never
@@ -110,6 +126,18 @@ def eff_window(cfg) -> int:
     if w <= 0 or w >= cfg.pbft_max_slots:
         return cfg.pbft_max_slots
     return w
+
+
+def queue_len(cfg) -> int:
+    """Static per-destination block-FIFO depth for queued-link mode: at most
+    one block is sent per interval, and the backlog after R rounds is
+    R * max(0, ser - interval) ticks ≈ backlog/ser undelivered blocks."""
+    ser = cfg.serialization_ticks(cfg.pbft_block_bytes)
+    if not cfg.queued_links or ser == 0:
+        return 1  # dummy registers; the ring path carries the blocks
+    r = min(cfg.pbft_max_rounds, cfg.pbft_max_slots)
+    backlog = max(0, ser - cfg.pbft_block_interval_ms) * r
+    return min(r, backlog // ser + 3)
 
 
 def init(cfg, key=None):
@@ -162,6 +190,10 @@ def init(cfg, key=None):
         honest=honest,
         seen_pp=zi(n, w),
         seen_vc=zi(n),
+        link_busy=zi(n),
+        ppq_tick=jnp.full((n, queue_len(cfg)), _NEVER, jnp.int32),
+        ppq_val=zi(n, queue_len(cfg)),
+        ppq_w=zi(n),
         slot_commits=zi(s),
         slot_commit_tick=jnp.full((s,), -1, jnp.int32),
         slot_propose_tick=jnp.full((s,), _NEVER, jnp.int32),
@@ -220,6 +252,14 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     ids = dv._global_ids(n_loc, axis)
     windows = jnp.arange(w)
 
+    ser = cfg.serialization_ticks(cfg.pbft_block_bytes)
+    # queued-link transport (cfg.queued_links): blocks ride per-destination
+    # serial-pipe FIFOs instead of the ring (see PbftState field comments);
+    # with ser == 0 the pipe is never busy and queued == constant-latency
+    # bit-exactly, so the plain ring path runs (engine.cpp behaves the same)
+    queued = cfg.queued_links and ser > 0
+    prop = cfg.link_delay_ms
+
     # ---- pop this tick's arrivals; crashed nodes process nothing ------------
     pp_t, pp = ring_pop(bufs.pp, t)
     prep_t, prep_rt = ring_pop(bufs.prep_rt, t)
@@ -228,6 +268,26 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     am = state.alive.astype(jnp.int32)
     pp_t, prep_t, com_t = pp_t * am[:, None], prep_t * am[:, None], com_t * am[:, None]
     vc_t = vc_t * am
+
+    # queued mode: this tick's serial-link block deliveries (exact mode is
+    # enforced by runner._reject_cpp_only, so window == slot identity).  A
+    # destination can receive TWO blocks in one tick — a view change frees
+    # the new leader's links while an old-leader block is still backlogged —
+    # so every hit scatters into its own window (same-window collisions are
+    # impossible: exact mode keys windows by slot identity), matching the
+    # C++ engine delivering both events.
+    if queued:
+        hits = state.ppq_tick == t  # [N, Q]
+        vals = jnp.where(hits & state.alive[:, None], state.ppq_val, 0)
+        ppq_tick = jnp.where(hits, _NEVER, state.ppq_tick)
+        oh_arr = (
+            ((vals - 1) % w)[:, :, None] == windows[None, None, :]
+        ) & (vals > 0)[:, :, None]  # [N, Q, W]
+        pp_t = jnp.maximum(
+            pp_t, jnp.max(jnp.where(oh_arr, vals[:, :, None], 0), axis=1)
+        )
+    else:
+        ppq_tick = state.ppq_tick
 
     # ---- gossip decode (topology="kregular"): the block-carrying channels
     # (PRE_PREPARE) and the control channel (VIEW_CHANGE) flood over the k-out
@@ -268,6 +328,18 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     has_vc = vc_t > 0
     v = jnp.where(has_vc, (vc_t - 1) // n, state.v)
     leader = jnp.where(has_vc, (vc_t - 1) % n, state.leader)
+    if queued:
+        # leadership rotated: the NEW leader's links are vote-only, hence
+        # free (votes never occupy the pipe — ser 0) in both engines; its
+        # busy registers start fresh.  VC arrivals all land before the next
+        # block tick (one-way hi <= interval, enforced by the runner), so
+        # the reset settles strictly between block sends.
+        any_vc = jnp.max(has_vc.astype(jnp.int32))
+        if axis is not None:
+            any_vc = jax.lax.pmax(any_vc, axis)
+        link_busy = jnp.where(any_vc > 0, 0, state.link_busy)
+    else:
+        link_busy = state.link_busy
 
     # ---- PRE_PREPARE arrivals: evict stale tenant, store, broadcast PREPARE -
     got_pp = pp_t > 0  # [N, W]  (any arrival re-broadcasts PREPARE — the
@@ -410,9 +482,38 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
         prep_sent = prep_sent & ~own_onehot
         committed_w = committed_w & ~own_onehot
     pp_val = own_onehot.astype(jnp.int32) * (next_n[:, None] + 1)
-    ser = cfg.serialization_ticks(cfg.pbft_block_bytes)
     k_pp = chan_key(tkey, Channel.DELAY_BCAST2)
-    if gossip:
+    if queued:
+        # serial-pipe send (engine.cpp link_enqueue): the packet reaches the
+        # (leader -> j) link after its random scheduling delay d_j - prop,
+        # transmission starts when the link frees, occupies it for ser, then
+        # propagates.  A single block sender is guaranteed (no drops ->
+        # consistent leader beliefs; enforced by runner._reject_cpp_only),
+        # so sender-side scalars globalize with pmax.
+        val_sent = jnp.max(jnp.where(send_block, next_n + 1, 0))
+        sender = jnp.max(jnp.where(send_block, ids, -1))
+        if axis is not None:
+            val_sent = jax.lax.pmax(val_sent, axis)
+            sender = jax.lax.pmax(sender, axis)
+        dest = (val_sent > 0) & (ids != sender)  # crashed peers still get
+        # the packet (C++ bcast sends to all); they ignore it at pop time
+        d_j = jax.random.randint(
+            dv._shard_key(k_pp, axis), (n_loc,), lo, hi, jnp.int32
+        )
+        link_at = t + d_j - prop
+        start = jnp.maximum(link_at, link_busy)
+        delivery = start + ser + prop
+        link_busy = jnp.where(dest, start + ser, link_busy)
+        q = ppq_tick.shape[1]
+        oh_q = (jnp.arange(q)[None, :] == (state.ppq_w % q)[:, None]) & dest[:, None]
+        ppq_tick = jnp.where(oh_q, delivery[:, None], ppq_tick)
+        ppq_val = jnp.where(oh_q, val_sent, state.ppq_val)
+        ppq_w = state.ppq_w + dest.astype(jnp.int32)
+    else:
+        ppq_val, ppq_w = state.ppq_val, state.ppq_w
+    if queued:
+        pass  # blocks already enqueued on the serial pipes; ring untouched
+    elif gossip:
         # origin injection (TTL = gossip_hops) + this tick's relays, one
         # flood push over the out-edges; every hop re-serializes the block
         # (store-and-forward), hence the ser term on each leg
@@ -446,7 +547,8 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
             zeros_w,
             axis,
         )
-    pp = ring_push_max(pp, t, lo + ser, pp_contrib)
+    if not queued:
+        pp = ring_push_max(pp, t, lo + ser, pp_contrib)
     rounds_sent = state.rounds_sent + send_block
     (slot_propose_tick,) = _scatter_window_events(
         None, None, state.slot_propose_tick,
@@ -499,6 +601,10 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     state = state.replace(
         seen_pp=seen_pp,
         seen_vc=seen_vc,
+        link_busy=link_busy,
+        ppq_tick=ppq_tick,
+        ppq_val=ppq_val,
+        ppq_w=ppq_w,
         v=v,
         leader=leader,
         next_n=next_n,

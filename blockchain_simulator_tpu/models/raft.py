@@ -117,6 +117,15 @@ class RaftState:
     # newer election than their own (real Raft's step-down-on-higher-term)
     # — ``my_base`` remembers the election a leader won.
     my_base: jax.Array        # [N] last election base this node fired with
+    # queued-link transport (cfg.queued_links; zeros when off): per-
+    # destination busy-until register for the CURRENT leader's serial links
+    # (same design as models/pbft.py — blocks only flow leader -> follower,
+    # so the busy state is [N] by destination, reset on leadership change; a
+    # 20 KB proposal serializes ~54 ms against the 50 ms heartbeat, so the
+    # backlog grows ~4 ms/round, bounded by (ser - hb) * raft_max_rounds —
+    # small enough that queued deliveries stay ON the rings, whose depth
+    # config.ring_depth widens accordingly; engine.cpp:198-215 is the twin).
+    link_busy: jax.Array      # [N]
 
 
 @struct.dataclass
@@ -177,6 +186,7 @@ def init(cfg, key=None):
         seen_hb=zi(n),
         seen_prop=zi(n),
         my_base=zi(n),
+        link_busy=zi(n),
     )
     if cfg.delivery == "stat":
         vreq = zi(d, n)
@@ -211,6 +221,10 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
     ids = dv._global_ids(n_loc, axis)
     zeros_flat = jnp.zeros((hi - lo, n_loc), jnp.int32)
     zeros_rt = jnp.zeros((len(rt_probs), n_loc), jnp.int32)
+    ser = cfg.serialization_ticks(cfg.raft_block_bytes)
+    # queued-link transport (see RaftState.link_busy): with ser == 0 the pipe
+    # is never busy and queued == constant-latency, so the plain path runs
+    queued = cfg.queued_links and ser > 0
 
     # ---- pop arrivals; crashed nodes process nothing ------------------------
     vreq_t, vreq = ring_pop(bufs.vreq, t)
@@ -447,6 +461,20 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
                             state.leader_tick)
     # loser: majority denied — release the vote latch and retry on the timer
     has_voted = has_voted & ~lose
+    if queued:
+        # leadership changed: the new leader's links are vote-only, hence
+        # free, in both engines (votes never occupy the pipe); its busy
+        # registers start fresh.  Already-scheduled deliveries from the old
+        # leader keep their ring slots, exactly like the C++ engine's
+        # in-flight events.
+        lead_prev = jnp.max(jnp.where(state.is_leader & state.alive, ids, -1))
+        lead_new = jnp.max(jnp.where(is_leader & state.alive, ids, -1))
+        if axis is not None:
+            lead_prev = jax.lax.pmax(lead_prev, axis)
+            lead_new = jax.lax.pmax(lead_new, axis)
+        link_busy = jnp.where(lead_new != lead_prev, 0, state.link_busy)
+    else:
+        link_busy = state.link_busy
 
     # ---- gossip: leader step-down on a newer election (see my_base) ---------
     if gossip:
@@ -593,9 +621,43 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
     hb_cnt = jnp.where(prop_send, 0, hb_cnt) if clean else hb_cnt
     hb_open = (hb_open | prop_send) if clean else hb_open
 
-    ser = cfg.serialization_ticks(cfg.raft_block_bytes)
     k_hb = chan_key(tkey, Channel.DELAY_BCAST2)
-    if gossip:
+    if queued:
+        # serial-pipe send (engine.cpp link_enqueue): the packet reaches the
+        # (leader -> j) link after its scheduling delay d_j - prop, transmits
+        # when the link frees (proposals occupy it for ser; 4-byte plain
+        # heartbeats queue behind but occupy nothing), then propagates.
+        # Deliveries land on the rings at dynamic per-destination offsets —
+        # bounded by the (ser - hb) * rounds backlog that config.ring_depth
+        # reserves — via scatter (fidelity-mode path; scatter cost is
+        # irrelevant at the n=8-ish scales queued fidelity runs at).
+        prop_ms = cfg.link_delay_ms
+        prop_val = jnp.max(jnp.where(prop_send, ids + 1, 0))
+        plain_on = jnp.max(plain_send.astype(jnp.int32))
+        sender = jnp.max(jnp.where(prop_send | plain_send, ids, -1))
+        if axis is not None:
+            prop_val = jax.lax.pmax(prop_val, axis)
+            plain_on = jax.lax.pmax(plain_on, axis)
+            sender = jax.lax.pmax(sender, axis)
+        any_send = (prop_val > 0) | (plain_on > 0)
+        dest = any_send & (ids != sender)  # crashed peers still reserve the
+        # pipe (C++ run_loop kind-2: reservation is sender-side)
+        d_j = jax.random.randint(
+            dv._shard_key(jax.random.fold_in(k_hb, 7), axis), (n_loc,), lo,
+            hi, jnp.int32,
+        )
+        ser_s = jnp.where(prop_val > 0, ser, 0)
+        start = jnp.maximum(t + d_j - prop_ms, link_busy)
+        delivery = start + ser_s + prop_ms
+        link_busy = jnp.where(dest, start + ser_s, link_busy)
+        dd = hb_prop.shape[0]
+        cols = jnp.arange(n_loc)
+        didx = jnp.where(dest, delivery % dd, dd)  # dd = out-of-bounds drop
+        hb_prop = hb_prop.at[didx, cols].max(
+            jnp.where(dest, prop_val, 0), mode="drop")
+        hb_plain = hb_plain.at[didx, cols].add(
+            (dest & (plain_on > 0)).astype(jnp.int32), mode="drop")
+    elif gossip:
         # plain heartbeats: tiny control messages, flooded with the tick as
         # the monotone base (concurrent leaders dedup to one — got_hb only
         # pacifies timers); proposals carry the 20 KB block, so every hop
@@ -668,7 +730,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
             zeros_flat,
             axis,
         )
-    if not gossip:
+    if not gossip and not queued:
         hb_plain = ring_push_add(hb_plain, t, lo, plain_contrib)
         hb_prop = ring_push_max(hb_prop, t, lo + ser, prop_contrib)
 
@@ -684,6 +746,34 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
     liars = state.alive & ~state.honest
     if gossip:
         pass
+    elif queued:
+        # the follower's ack is a 4-byte reply over the (follower -> leader)
+        # link, which is never busy (followers send no blocks): it departs at
+        # the proposal's queued DELIVERY tick and lands one one-way delay
+        # later.  Ack ticks are per-destination, the receiver is the single
+        # leader row: bucket them into a [D] histogram (psum'd across shards)
+        # and add it into the leader's ring column on the owning shard.
+        d2 = jax.random.randint(
+            dv._shard_key(jax.random.fold_in(k_rt, 9), axis), (n_loc,), lo,
+            hi, jnp.int32,
+        )
+        ack_arr = delivery + d2
+        prop_on = prop_val > 0
+        okd = dest & prop_on & voters
+        badd = dest & prop_on & liars
+        dd = hb_ok.shape[0]
+        hist_ok = jnp.zeros((dd,), jnp.int32).at[
+            jnp.where(okd, ack_arr % dd, dd)].add(1, mode="drop")
+        hist_bad = jnp.zeros((dd,), jnp.int32).at[
+            jnp.where(badd, ack_arr % dd, dd)].add(1, mode="drop")
+        if axis is not None:
+            hist_ok = jax.lax.psum(hist_ok, axis)
+            hist_bad = jax.lax.psum(hist_bad, axis)
+        col = sender - ids[0]
+        owned = prop_on & (col >= 0) & (col < n_loc)
+        col_c = jnp.clip(col, 0, n_loc - 1)
+        hb_ok = hb_ok.at[:, col_c].add(jnp.where(owned, hist_ok, 0))
+        hb_bad = hb_bad.at[:, col_c].add(jnp.where(owned, hist_bad, 0))
     elif stat:
         n_voters = _psum_scalar(voters.astype(jnp.int32).sum(), axis)
         n_liars = _psum_scalar(liars.astype(jnp.int32).sum(), axis)
@@ -720,7 +810,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
             zeros_rt,
             axis,
         )
-    if not gossip:
+    if not gossip and not queued:
         hb_ok = ring_push_add(hb_ok, t, rt_lo + ser, ok_counts)
         hb_bad = ring_push_add(hb_bad, t, rt_lo + ser, bad_counts)
 
@@ -746,6 +836,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
         seen_hb=seen_hb,
         seen_prop=seen_prop,
         my_base=my_base,
+        link_busy=link_busy,
     )
     bufs = RaftBufs(
         vreq=vreq, vres_ok=vres_ok, vres_no=vres_no, hb_plain=hb_plain,

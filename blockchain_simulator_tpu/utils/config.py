@@ -82,11 +82,22 @@ class SimConfig:
     # False to model propagation + the explicit random scheduling delay only
     # (the round-blocked PBFT fast path requires this).
     model_serialization: bool = True
-    # ns-3-exact queued transport (C++ engine only): each directed link is a
-    # serial 3 Mbps pipe — a packet transmits when the link is free, occupies
-    # it for its serialization time, then propagates; small votes queue
-    # behind blocks on the same link.  The tensorized backends keep the
-    # constant-latency model and refuse this flag.
+    # ns-3-exact queued transport: each directed link is a serial 3 Mbps
+    # pipe — a packet transmits when the link is free, occupies it for its
+    # serialization time, then propagates; small votes queue behind blocks
+    # on the same link.  Modeled per-edge by the C++ engine
+    # (engine.cpp:198-215, all protocols) and by the tensorized engines via
+    # per-destination busy registers for the leader's block channel: pbft
+    # routes queued blocks through per-destination FIFOs (models/pbft.py —
+    # its backlog is unbounded), raft keeps them on rings widened by the
+    # bounded (ser - hb) * rounds backlog and queues plain heartbeats behind
+    # in-flight proposals (models/raft.py).  4-byte vote/control unicast
+    # traffic keeps constant latency — a documented divergence: a sender's
+    # own votes never queue behind its in-flight blocks, which moves no
+    # milestone since thresholds never hinge on the one leader vote;
+    # tests/test_fidelity.py pins both engines against each other.  Paxos
+    # messages are all 3-4 bytes, so queued == constant-latency there
+    # (accepted as a bit-exact no-op).  The mixed shard sim refuses the flag.
     queued_links: bool = False
 
     # --- topology -----------------------------------------------------------
@@ -333,9 +344,23 @@ class SimConfig:
         rt_hi - 1 + ser; 20 KB at 3 Mbps ≈ 54 ticks)."""
         _, rt_hi = self.roundtrip_range()
         if self.protocol == "pbft":
-            biggest = self.pbft_block_bytes
+            # queued-link mode routes blocks through per-destination serial-
+            # pipe FIFOs (models/pbft.py PbftState registers) — their delivery
+            # offsets are unbounded and never touch the ring, which then only
+            # carries 4-byte vote/control traffic
+            biggest = 0 if self.queued_links else self.pbft_block_bytes
         elif self.protocol == "raft":
             biggest = self.raft_block_bytes
+            if self.queued_links:
+                # queued raft deliveries stay on the rings: the serial-pipe
+                # backlog is bounded — a proposal serializes ser ticks but
+                # departs every heartbeat, so after R proposal rounds the
+                # per-link queue holds at most (ser - hb) * R extra ticks
+                # (models/raft.py link_busy; the backlog resets with the
+                # leader's links on a leadership change)
+                ser = self.serialization_ticks(biggest)
+                extra = max(0, ser - self.raft_heartbeat_ms) * self.raft_max_rounds
+                return rt_hi + ser + 1 + extra
         else:
             biggest = 4
         return rt_hi + self.serialization_ticks(biggest) + 1

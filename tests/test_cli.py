@@ -76,7 +76,22 @@ def test_cpp_fidelity_flags(capsys):
 
 def test_cpp_only_flags_rejected_on_jax_engine(capsys):
     assert main(["--protocol", "pbft", "--echo-back"]) == 2
-    assert main(["--protocol", "pbft", "--queued-links"]) == 2
+    # tensorized queued links cover pbft/raft/paxos; the mixed sim refuses,
+    # and ineligible pbft shapes get a clean message + exit 2
+    assert main(["--protocol", "mixed", "--n", "64", "--queued-links"]) == 2
+    assert main(["--protocol", "pbft", "--queued-links",
+                 "--pbft-window", "4"]) == 2
+    err = capsys.readouterr().err
+    assert "exact vote table" in err
+
+
+def test_paxos_client_config_error_is_clean(capsys):
+    # SimConfig ValueErrors surface as a message + exit 2, not a traceback
+    # (same UX as the flag checks; ADVICE r4)
+    assert main(["--protocol", "paxos", "--paxos-client", "5", "0",
+                 "--paxos-proposers", "3"]) == 2
+    err = capsys.readouterr().err
+    assert "proposer lane" in err
 
 
 def test_paxos_client_flag(capsys):

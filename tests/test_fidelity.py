@@ -139,6 +139,116 @@ def test_queued_links_raft_still_replicates():
     assert m["agreement_ok"]
 
 
-def test_queued_links_rejected_by_jax_engines():
-    with pytest.raises(NotImplementedError, match="queued_links"):
-        make_sim_fn(PBFT.with_(queued_links=True))
+def test_queued_links_jax_pbft_backlog_matches_cpp():
+    # The tensorized PBFT engine's per-destination serial-pipe registers
+    # (models/pbft.py) must reproduce the C++ engine's queued transport:
+    # identical milestone counts, and — because the backlog recursion
+    # start = max(arrival, busy); busy = start + ser is deterministic up to
+    # the first round's +-3-tick scheduling draw — finality times within a
+    # few ticks despite the engines' unrelated RNGs.
+    # view changes off for the tight timing pin: a VC hands the links to a
+    # fresh leader and restarts the backlog, so engines with independent VC
+    # draws diverge by ~86 ms per round of draw difference — with VCs the
+    # counts still match (asserted below), the tick-level drift does not
+    cfg = PBFT.with_(sim_ms=10_000, pbft_max_rounds=40, pbft_max_slots=64,
+                     queued_links=True, pbft_view_change_num=0)
+    mc = run_cpp(cfg)
+    mj = run_simulation(cfg)
+    assert mj["rounds_sent"] == mc["rounds_sent"] == 40
+    assert mj["blocks_final_all_nodes"] == mc["blocks_final_all_nodes"] == 40
+    assert mj["agreement_ok"] and mc["agreement_ok"]
+    assert mj["view_changes"] == mc["view_changes"] == 0
+    assert abs(mj["last_commit_ms"] - mc["last_commit_ms"]) <= 20
+    assert abs(mj["mean_time_to_finality_ms"]
+               - mc["mean_time_to_finality_ms"]) <= 20
+    # with view changes enabled: counts agree, backlog magnitude agrees
+    cfg_vc = cfg.with_(pbft_view_change_num=1)
+    mc_vc, mj_vc = run_cpp(cfg_vc), run_simulation(cfg_vc)
+    assert mj_vc["blocks_final_all_nodes"] == mc_vc["blocks_final_all_nodes"] == 40
+    assert abs(mj_vc["last_commit_ms"] - mc_vc["last_commit_ms"]) <= 40 * 90
+
+
+def test_queued_links_jax_backlog_grows_vs_constant():
+    # same quantification as the C++ test above, on the tensorized engine:
+    # 40 rounds x ~86 ms/round of accumulated queueing
+    cfg = SimConfig(protocol="pbft", n=8, sim_ms=10_000)
+    const = run_simulation(cfg)
+    queued = run_simulation(cfg.with_(queued_links=True))
+    assert queued["rounds_sent"] == const["rounds_sent"] == 40
+    assert queued["blocks_final_all_nodes"] == const["blocks_final_all_nodes"] == 40
+    assert queued["last_commit_ms"] > const["last_commit_ms"] + 2500
+    assert (queued["mean_time_to_finality_ms"]
+            > const["mean_time_to_finality_ms"] + 1000)
+
+
+def test_queued_links_jax_raft_matches_cpp():
+    # tensorized queued raft (widened rings + per-destination busy registers):
+    # a 20 KB proposal serializes ~54 ms against the 50 ms heartbeat, so acks
+    # lag a growing ~4 ms/round backlog; replication must still complete on
+    # both engines with comparable block counts
+    cfg = SimConfig(protocol="raft", n=8, sim_ms=8000, queued_links=True)
+    mc = run_cpp(cfg)
+    mj = run_simulation(cfg)
+    assert mj["n_leaders"] == mc["n_leaders"] == 1
+    assert mj["agreement_ok"] and mc["agreement_ok"]
+    assert mj["blocks"] >= 40 and mc["blocks"] >= 40
+    # and the backlog visibly stretches replication vs the constant model
+    const = run_simulation(cfg.with_(queued_links=False))
+    assert mj["last_block_ms"] >= const["last_block_ms"]
+
+
+def test_queued_links_jax_raft_zero_ser_is_identical():
+    # serialization off -> ser = 0 -> the queued flag is a bit-exact no-op
+    cfg = SimConfig(protocol="raft", n=8, sim_ms=4000,
+                    model_serialization=False)
+    assert (run_simulation(cfg.with_(queued_links=True))
+            == run_simulation(cfg))
+
+
+def test_queued_links_jax_paxos_is_constant_latency():
+    # paxos messages are 3-4 bytes (ser = 0): the pipe is never busy and the
+    # tensorized engine's queued mode IS its constant-latency mode
+    cfg = SimConfig(protocol="paxos", n=8, sim_ms=6000)
+    assert run_simulation(cfg.with_(queued_links=True)) == run_simulation(cfg)
+
+
+def test_queued_links_jax_gates():
+    from blockchain_simulator_tpu.parallel.mesh import make_mesh
+    from blockchain_simulator_tpu.parallel.shard import make_sharded_sim_fn
+
+    with pytest.raises(NotImplementedError, match="mixed"):
+        make_sim_fn(SimConfig(protocol="mixed", n=64, queued_links=True))
+    with pytest.raises(ValueError, match="exact vote table"):
+        make_sim_fn(SimConfig(protocol="pbft", n=8, queued_links=True,
+                              pbft_window=8, pbft_max_slots=64))
+    with pytest.raises(ValueError, match="drop_prob"):
+        make_sim_fn(PBFT.with_(queued_links=True,
+                               faults=FaultConfig(drop_prob=0.01)))
+    with pytest.raises(ValueError, match="topology"):
+        make_sharded_sim_fn(
+            SimConfig(protocol="pbft", n=512, queued_links=True,
+                      topology="kregular"),
+            make_mesh(n_node_shards=4),
+        )
+
+
+def test_queued_links_jax_sharded_matches_unsharded():
+    # the per-destination registers are [N]-sharded state; the sharded scan
+    # must agree with the single-device run on milestone counts
+    from blockchain_simulator_tpu.parallel.mesh import make_mesh
+    from blockchain_simulator_tpu.parallel.shard import run_sharded
+
+    # view changes off: under a serial-pipe backlog a post-VC leader's
+    # next_n lags the queued PRE_PREPAREs, so it re-proposes a stale slot
+    # and shifts the tail by a block interval — faithful (the C++ engine
+    # does the same), but sharded/unsharded VC draws are decorrelated, so
+    # the deterministic-backlog configuration is what pins equivalence
+    cfg = SimConfig(protocol="pbft", n=16, sim_ms=3000, pbft_max_rounds=12,
+                    queued_links=True, pbft_view_change_num=0)
+    single = run_simulation(cfg)
+    sharded = run_sharded(cfg, make_mesh(n_node_shards=4))
+    for k in ("rounds_sent", "blocks_final_all_nodes", "agreement_ok"):
+        assert sharded[k] == single[k], k
+    # per-shard delay draws are decorrelated (ops/delivery._shard_key), so
+    # times agree within the delay distribution, not bit-exactly
+    assert abs(sharded["last_commit_ms"] - single["last_commit_ms"]) <= 10

@@ -1,0 +1,100 @@
+"""Roofline analysis of the headline path (models/pbft_round.py).
+
+VERDICT r4 weak-#6: the repo had a roofline for the tick engine's ring pushes
+(ARTIFACT_ring_kernel.json: DUS chain ~75% of the HBM bound) but nothing for
+the round-blocked fast path that carries the 2222 rounds/s headline.  This
+tool answers: what fraction of a v5e's HBM bandwidth / vector FLOP peak does
+the fast path achieve, and how much headroom is left?
+
+Method: XLA's own cost analysis of the compiled whole-run executable
+(``jit(sim).lower(key).compile().cost_analysis()`` -> flops, bytes accessed),
+divided by the number of simulated rounds, against the measured wall clock
+per round (same force_sync timing policy as bench.py).  Cost analysis is of
+the executable actually compiled for the backend this runs on — run it on
+the TPU for the headline numbers; the CPU fallback is labeled (fusion
+decisions differ, so CPU-derived bytes are an approximation of the TPU
+program's).
+
+v5e single-chip peaks (public spec): 819 GB/s HBM BW, 197 TFLOP/s bf16 MXU.
+The round step is [N]-vector int32/f32 elementwise + PRNG work — no matmuls
+— so the relevant ceilings are HBM bytes and VPU flops; we report HBM
+utilization (the binding one for streaming vector code) plus the raw flop
+rate for context.
+
+Prints one JSON object; run in a fresh child process (KNOWN_ISSUES.md #2).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+N = int(os.environ.get("ROOFLINE_N", "100000"))
+ROUNDS = int(os.environ.get("ROOFLINE_ROUNDS", "2000"))
+V5E_HBM_BYTES_S = 819e9
+V5E_BF16_FLOPS = 197e12
+
+
+def main() -> int:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    os.environ["BENCH_N"] = str(N)  # bench reads its N at import time
+    from bench import _cfg, _measure
+
+    cfg = _cfg(ROUNDS)
+    from blockchain_simulator_tpu.runner import make_sim_fn, use_round_schedule
+
+    assert use_round_schedule(cfg), "headline config must resolve to the round path"
+    sim = make_sim_fn(cfg)
+    key = jax.random.key(0)
+
+    t0 = time.monotonic()
+    compiled = jax.jit(sim).lower(key).compile()
+    lower_s = time.monotonic() - t0
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):  # older jax returns one dict per device program
+        ca = ca[0]
+    flops = float(ca.get("flops", 0.0))
+    bytes_acc = float(ca.get("bytes accessed", 0.0))
+
+    value, rounds_done, wall, compile_s = _measure(cfg, batch=1)
+    per_round_s = wall / max(rounds_done, 1)
+    bytes_per_round = bytes_acc / ROUNDS
+    flops_per_round = flops / ROUNDS
+    hbm_util = (bytes_per_round / per_round_s) / V5E_HBM_BYTES_S
+    out = {
+        "n": N,
+        "rounds": ROUNDS,
+        "backend": jax.default_backend(),
+        "rounds_per_sec": round(value, 2),
+        "per_round_us": round(per_round_s * 1e6, 1),
+        "xla_bytes_accessed_per_round": round(bytes_per_round),
+        "xla_flops_per_round": round(flops_per_round),
+        "achieved_GBps": round(bytes_per_round / per_round_s / 1e9, 2),
+        "achieved_GFLOPs": round(flops_per_round / per_round_s / 1e9, 2),
+        "v5e_hbm_peak_GBps": V5E_HBM_BYTES_S / 1e9,
+        "hbm_utilization": round(hbm_util, 4),
+        "flop_utilization_vs_mxu_peak": round(
+            (flops_per_round / per_round_s) / V5E_BF16_FLOPS, 6
+        ),
+        "lower_compile_s": round(lower_s, 1),
+        "measure_compile_s": round(compile_s, 1),
+        "note": (
+            "elementwise [N]-vector program (no matmuls): the binding "
+            "ceilings are HBM bytes and VPU throughput; hbm_utilization "
+            "<< 1 means the path is dispatch/latency-bound per scan step, "
+            "i.e. throughput rises with N at ~constant wall per round"
+        ),
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,65 @@
+"""Tunnel-health probe: one tiny jitted matmul on the default backend.
+
+Run as a standalone child process that is NEVER killed (KNOWN_ISSUES.md #3:
+hard-killing a TPU client mid-compile wedges the single-client tunnel for
+hours).  The probe prints one JSON line and exits 0 on success; on any
+exception it prints a JSON line with an "error" field and exits 1.  A caller
+that sees no output within its patience window should conclude the tunnel is
+sick and move on WITHOUT killing this process if at all avoidable.
+
+Stages are timestamped to stderr so a watcher can tell init-hang from
+compile-hang.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+T0 = time.monotonic()
+
+
+def log(msg: str) -> None:
+    print(f"[probe +{time.monotonic() - T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    log("importing jax")
+    import jax
+    import jax.numpy as jnp
+
+    log("initializing backend")
+    t = time.monotonic()
+    backend = jax.default_backend()
+    devs = jax.devices()
+    init_s = time.monotonic() - t
+    log(f"backend={backend} devices={len(devs)} init={init_s:.1f}s")
+
+    log("compiling tiny matmul")
+    t = time.monotonic()
+    f = jax.jit(lambda a, b: (a @ b).sum())
+    a = jnp.ones((128, 128), jnp.bfloat16)
+    out = f(a, a)
+    val = float(out)  # forced readback — the only sync this env honors
+    compile_s = time.monotonic() - t
+    log(f"compiled+ran in {compile_s:.1f}s, value={val}")
+
+    print(json.dumps({
+        "ok": True,
+        "backend": backend,
+        "n_devices": len(devs),
+        "init_s": round(init_s, 2),
+        "compile_s": round(compile_s, 2),
+        "value": val,
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"ok": False, "error": repr(e)[:500]}), flush=True)
+        log(f"FAILED: {e!r}")
+        sys.exit(1)

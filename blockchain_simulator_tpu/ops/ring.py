@@ -45,6 +45,14 @@ def _push(buf, t, lo: int, contrib, op: str):
       form ~30x slower than this chain; the pallas kernel removes the chain's
       remaining per-pair copy cost (round-4 measurement in
       ARTIFACT_ring_kernel.json).
+
+    Lowering selection is PROCESS-SCOPED: ``ring_kernel.enabled()`` reads
+    ``BLOCKSIM_RING_KERNEL`` at trace time, and traced sim fns are cached by
+    config (runner.make_sim_fn / parallel.shard lru_caches), so flipping the
+    env var mid-process keeps previously built fns on their old lowering.
+    Set the variable before building sim fns (or clear the caches via
+    ``make_sim_fn.cache_clear()``) — tools/ring_kernel_bench.py runs each
+    mode in a fresh child process for exactly this reason.
     """
     from blockchain_simulator_tpu.ops import ring_kernel
 

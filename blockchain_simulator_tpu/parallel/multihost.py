@@ -80,6 +80,9 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--delivery", default="edge")
     p.add_argument("--serialization", choices=["on", "off"], default="on")
+    p.add_argument("--schedule", choices=["tick", "round", "auto"],
+                   default="auto", help="stepping granularity; 'round' pins "
+                   "the PBFT round-blocked fast path (models/pbft_round.py)")
     args = p.parse_args(argv)
 
     if args.force_cpu_devices:
@@ -105,6 +108,7 @@ def main(argv=None) -> int:
         seed=args.seed,
         delivery=args.delivery,
         model_serialization=args.serialization == "on",
+        schedule=args.schedule,
     )
     m = run_sharded_multihost(cfg)
     if jax.process_index() == 0:

@@ -60,7 +60,11 @@ SCHEMAS = {
     },
     "paxos": {
         "REQUEST_TICKET": ("ticket",),           # paxos-node.cc:511-518
-        "RESPONSE_TICKET": ("state", "command"),  # paxos-node.cc:177-197
+        # state-conditional: the SUCCESS promise carries the stored command,
+        # the FAILED reply is ['type','fail'] only — its byte 3 is
+        # uninitialized stack garbage upstream (paxos-node.cc:177-197), so
+        # decode() returns 'command' only when state == SUCCESS (0)
+        "RESPONSE_TICKET": ("state", "command"),
         "REQUEST_PROPOSE": ("ticket", "command"),  # paxos-node.cc:258-274
         "RESPONSE_PROPOSE": ("state",),          # paxos-node.cc:199-221
         "REQUEST_COMMIT": ("ticket", "command"),  # paxos-node.cc:295-305
@@ -132,6 +136,16 @@ def decode(protocol: str, data: bytes) -> tuple[str, dict[str, int]]:
         raise ValueError(f"unknown/unused {protocol} message type byte {data[0]!r}")
     name = by_val[t]
     schema = SCHEMAS[protocol][name]
+    # state-conditional layout: a paxos RESPONSE_TICKET FAILED reply carries
+    # no command (upstream leaves byte 3 uninitialized, paxos-node.cc:190-193)
+    # — surface only the fields the sender actually wrote
+    if (
+        protocol == "paxos"
+        and name == "RESPONSE_TICKET"
+        and len(data) >= 2
+        and char_to_int(data[1]) != 0  # SUCCESS == 0 (paxos-node.h:85)
+    ):
+        schema = schema[:1]
     if len(data) < 1 + len(schema):
         raise ValueError(
             f"{protocol}/{name} needs {1 + len(schema)} bytes, got {len(data)}"

@@ -21,6 +21,9 @@ def test_roundtrip_every_message_type():
     for proto, schemas in codec.SCHEMAS.items():
         for name, fields in schemas.items():
             vals = tuple((i + 3) % 10 for i in range(len(fields)))
+            if (proto, name) == ("paxos", "RESPONSE_TICKET"):
+                vals = (0,) + vals[1:]  # SUCCESS: the only state whose reply
+                # carries the command byte (state-conditional schema)
             wire = codec.encode(proto, name, *vals)
             assert len(wire) == 1 + len(fields)  # 3-4 ASCII bytes (1-3 here)
             back_name, back = codec.decode(proto, wire)
@@ -66,3 +69,19 @@ def test_truncated_packet_rejected():
     wire = codec.encode("pbft", "PREPARE", 1, 2, 3)
     with pytest.raises(ValueError, match="needs"):
         codec.decode("pbft", wire[:2])
+
+
+def test_paxos_response_ticket_failed_drops_command():
+    # The FAILED promise is ['type','fail'] only — upstream leaves byte 3
+    # uninitialized (paxos-node.cc:190-193), so decoding must not surface a
+    # garbage 'command' field as meaningful.  SUCCESS (0) carries it.
+    ok = codec.encode("paxos", "RESPONSE_TICKET", 0, 7)
+    name, fields = codec.decode("paxos", ok)
+    assert name == "RESPONSE_TICKET" and fields == {"state": 0, "command": 7}
+    # a 2-byte FAILED reply decodes cleanly without the command byte
+    failed = bytes([codec.int_to_char(3), codec.int_to_char(1)])
+    name, fields = codec.decode("paxos", failed)
+    assert name == "RESPONSE_TICKET" and fields == {"state": 1}
+    # and a FAILED reply that happens to carry a garbage third byte ignores it
+    name, fields = codec.decode("paxos", failed + b"\x07")
+    assert fields == {"state": 1}

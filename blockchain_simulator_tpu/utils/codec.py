@@ -107,6 +107,15 @@ def encode(protocol: str, msg_type: str, *fields: int, strict: bool = True,
     overwrites (pbft-node.cc:79-95: the header is written INTO the block
     buffer, so the wire length is the block size, not header + block)."""
     schema = _schema(protocol, msg_type)
+    # state-conditional layout: the paxos RESPONSE_TICKET FAILED reply is
+    # ['type','fail'] only (paxos-node.cc:190-193) — encode it without a
+    # command byte, mirroring decode()
+    if (
+        (protocol, msg_type) == ("paxos", "RESPONSE_TICKET")
+        and fields
+        and fields[0] != 0  # SUCCESS == 0 (paxos-node.h:85)
+    ):
+        schema = schema[:1]
     if len(fields) != len(schema):
         raise ValueError(
             f"{protocol}/{msg_type} takes fields {schema}, got {len(fields)}"

@@ -111,3 +111,17 @@ def test_checkpoint_other_protocols(tmp_path):
         m_full = run_simulation(cfg)
         m_seg, _ = run_checkpointed(cfg, every_ms=250, ckpt_dir=tmp_path / proto_name)
         assert m_seg == m_full
+
+
+def test_checkpoint_queued_links(tmp_path):
+    # the serial-pipe registers (pbft FIFOs/busy, raft widened rings +
+    # link_busy) are ordinary state/buffer leaves: segmented execution must
+    # stay bit-exact through a checkpoint boundary mid-backlog
+    for proto_name, ms in (("pbft", 700), ("raft", 900)):
+        cfg = SimConfig(protocol=proto_name, n=8, sim_ms=ms, queued_links=True)
+        m_full = run_simulation(cfg)
+        m_seg, last = run_checkpointed(
+            cfg, every_ms=300, ckpt_dir=tmp_path / proto_name
+        )
+        assert m_seg == m_full
+        assert resume_simulation(last) == m_full

@@ -78,8 +78,9 @@ def test_paxos_response_ticket_failed_drops_command():
     ok = codec.encode("paxos", "RESPONSE_TICKET", 0, 7)
     name, fields = codec.decode("paxos", ok)
     assert name == "RESPONSE_TICKET" and fields == {"state": 0, "command": 7}
-    # a 2-byte FAILED reply decodes cleanly without the command byte
-    failed = bytes([codec.int_to_char(3), codec.int_to_char(1)])
+    # the FAILED reply encodes AND decodes as the 2-byte form
+    failed = codec.encode("paxos", "RESPONSE_TICKET", 1)
+    assert failed == bytes([codec.int_to_char(3), codec.int_to_char(1)])
     name, fields = codec.decode("paxos", failed)
     assert name == "RESPONSE_TICKET" and fields == {"state": 1}
     # and a FAILED reply that happens to carry a garbage third byte ignores it

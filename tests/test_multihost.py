@@ -18,8 +18,6 @@ import socket
 import subprocess
 import sys
 
-import pytest
-
 from blockchain_simulator_tpu.parallel.mesh import make_mesh
 from blockchain_simulator_tpu.parallel.shard import run_sharded
 from blockchain_simulator_tpu.utils.config import SimConfig

@@ -205,6 +205,23 @@ def test_queued_links_jax_raft_zero_ser_is_identical():
             == run_simulation(cfg))
 
 
+# one shared deterministic-backlog config (VCs off) pins BOTH equivalences
+# below — stat-vs-edge and sharded-vs-unsharded must validate the same shape
+QUEUED_DET = SimConfig(protocol="pbft", n=16, sim_ms=3000, pbft_max_rounds=12,
+                       queued_links=True, pbft_view_change_num=0)
+
+
+def test_queued_links_stat_delivery_matches_edge():
+    # the queued block channel uses per-destination draws regardless of the
+    # vote channels' delivery mode; stat and edge runs must agree on counts
+    # and on the deterministic backlog timing (VCs off)
+    edge = run_simulation(QUEUED_DET)
+    stat = run_simulation(QUEUED_DET.with_(delivery="stat"))
+    for k in ("rounds_sent", "blocks_final_all_nodes", "agreement_ok"):
+        assert stat[k] == edge[k], k
+    assert abs(stat["last_commit_ms"] - edge["last_commit_ms"]) <= 10
+
+
 def test_queued_links_jax_paxos_is_constant_latency():
     # paxos messages are 3-4 bytes (ser = 0): the pipe is never busy and the
     # tensorized engine's queued mode IS its constant-latency mode
@@ -243,8 +260,7 @@ def test_queued_links_jax_sharded_matches_unsharded():
     # and shifts the tail by a block interval — faithful (the C++ engine
     # does the same), but sharded/unsharded VC draws are decorrelated, so
     # the deterministic-backlog configuration is what pins equivalence
-    cfg = SimConfig(protocol="pbft", n=16, sim_ms=3000, pbft_max_rounds=12,
-                    queued_links=True, pbft_view_change_num=0)
+    cfg = QUEUED_DET
     single = run_simulation(cfg)
     sharded = run_sharded(cfg, make_mesh(n_node_shards=4))
     for k in ("rounds_sent", "blocks_final_all_nodes", "agreement_ok"):

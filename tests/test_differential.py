@@ -40,6 +40,28 @@ def test_pbft_differential(fidelity):
     assert abs(mc["mean_time_to_finality_ms"] - mj["mean_time_to_finality_ms"]) < 6
 
 
+def test_pbft_round_path_serialized_vs_cpp():
+    # the serialization-aware ROUND fast path directly against the C++
+    # event-heap engine at the sustainable operating point (300 tx/s,
+    # 200 ms interval -> 160-tick constant block serialization): the
+    # round-vs-tick and tick-vs-C++ chains each pin this transitively,
+    # but the headline schedule deserves the direct cross-engine pin.
+    # VCs off: engines draw them independently.
+    # sim_ms=4400: 21 block ticks (200..4200), the last wave lands by
+    # 4200 + ser(160) + horizon(32) = 4392 < 4400 — every round closes
+    cfg = SimConfig(protocol="pbft", n=8, sim_ms=4400, delivery="stat",
+                    pbft_block_interval_ms=200, pbft_tx_speed=300,
+                    pbft_view_change_num=0, schedule="round")
+    mj = run_simulation(cfg)
+    mc = run_cpp(cfg)
+    assert mc["rounds_sent"] == mj["rounds_sent"] == 21
+    assert mc["blocks_final_all_nodes"] == mj["blocks_final_all_nodes"] == 21
+    assert mc["agreement_ok"] and mj["agreement_ok"]
+    # commits land ser (160) + wave (~28) after each propose, both engines
+    assert mj["mean_time_to_finality_ms"] > 160
+    assert abs(mc["mean_time_to_finality_ms"] - mj["mean_time_to_finality_ms"]) < 6
+
+
 @pytest.mark.parametrize("fidelity", ["clean", "reference"])
 def test_raft_differential(fidelity):
     cfg = SimConfig(protocol="raft", n=8, sim_ms=6000, fidelity=fidelity)

@@ -90,9 +90,15 @@ CHILD_GRACE_S = 120
 CPU_RESERVE_S = 180
 
 
+V5E_HBM_BYTES_S = 819e9  # single-chip HBM bandwidth, public v5e spec
+
+
 def _measure(cfg, batch: int):
     """Compile+warm+measure one config; returns (value, rounds_done, wall_s,
-    compile_s)."""
+    compile_s, cost) — ``cost`` is XLA's own {flops, bytes accessed} of the
+    compiled executable (None if unavailable), the basis of the roofline
+    fields on the result line (VERDICT r4 weak-#6: state utilization on the
+    headline artifact; tools/roofline_round.py is the standalone variant)."""
     import jax
     import jax.numpy as jnp
 
@@ -116,6 +122,15 @@ def _measure(cfg, batch: int):
     # a data dependency that cannot be satisfied early.
     final = force_sync(run(keys(0)))  # compile + warm
     compile_s = time.perf_counter() - tc
+    cost = None
+    try:
+        ca = run.lower(keys(0)).compile().cost_analysis()  # cached compile
+        if isinstance(ca, list):
+            ca = ca[0]
+        cost = {"flops": float(ca.get("flops", 0.0)),
+                "bytes": float(ca.get("bytes accessed", 0.0))}
+    except Exception:  # cost analysis is evidence, never a failure mode
+        pass
     t0 = time.perf_counter()
     final = force_sync(run(keys(100)))
     wall = time.perf_counter() - t0
@@ -128,7 +143,7 @@ def _measure(cfg, batch: int):
         )
     else:
         rounds_done = int(proto.metrics(cfg, final)["blocks_final_all_nodes"])
-    return rounds_done / wall, rounds_done, wall, compile_s
+    return rounds_done / wall, rounds_done, wall, compile_s, cost
 
 
 def _cfg(rounds: int):
@@ -212,7 +227,8 @@ def child() -> None:
 
     batch = int(os.environ.get("BENCH_BATCH", "1"))
 
-    def emit(value, rounds_done, wall, compile_s, rounds_cfg, tag=None):
+    def emit(value, rounds_done, wall, compile_s, rounds_cfg, cost=None,
+             tag=None):
         rec = {
             "metric": METRIC if tag is None else f"{METRIC}__{tag}",
             "value": round(value, 2),
@@ -225,6 +241,17 @@ def child() -> None:
             "wall_s": round(wall, 3),
             "compile_s": round(compile_s, 1),
         }
+        if cost and cost.get("bytes", 0) > 0 and wall > 0:
+            # roofline evidence on the artifact itself: XLA's own cost
+            # analysis of the executed program vs the measured wall (the
+            # vmapped batch>1 executable covers batch*rounds_cfg rounds)
+            per = max(rounds_cfg, 1) * max(batch, 1)
+            rec["xla_bytes_per_round"] = round(cost["bytes"] / per)
+            rec["xla_flops_per_round"] = round(cost["flops"] / per)
+            rec["achieved_GBps"] = round(cost["bytes"] / wall / 1e9, 2)
+            if backend != "cpu":
+                rec["hbm_utilization_vs_v5e_peak"] = round(
+                    cost["bytes"] / wall / V5E_HBM_BYTES_S, 4)
         if tag is not None:
             rec["tag"] = tag
         print(json.dumps(rec), flush=True)
@@ -253,8 +280,8 @@ def child() -> None:
                     file=sys.stderr,
                 )
                 return
-        value, rounds_done, wall, compile_s = _measure(_cfg(rounds), batch)
-        emit(value, rounds_done, wall, compile_s, rounds)
+        value, rounds_done, wall, compile_s, cost = _measure(_cfg(rounds), batch)
+        emit(value, rounds_done, wall, compile_s, rounds, cost=cost)
         prev = (value, rounds_done, wall, compile_s)
 
     # ---- companion: serialization-on model (same fast path, shifted wave) --
@@ -268,8 +295,9 @@ def child() -> None:
                 file=sys.stderr,
             )
             return
-        value, rounds_done, wall, compile_s = _measure(_cfg_ser(ROUNDS_SER), batch)
-        emit(value, rounds_done, wall, compile_s, ROUNDS_SER,
+        value, rounds_done, wall, compile_s, cost = _measure(
+            _cfg_ser(ROUNDS_SER), batch)
+        emit(value, rounds_done, wall, compile_s, ROUNDS_SER, cost=cost,
              tag="serialization_on")
 
 
@@ -311,7 +339,9 @@ def _assemble(results: list[dict], probe: dict | None) -> dict | None:
         main["serialization_on"] = {
             k: companion[k]
             for k in ("value", "unit", "rounds", "rounds_cfg", "wall_s",
-                      "compile_s")
+                      "compile_s", "xla_bytes_per_round",
+                      "xla_flops_per_round", "achieved_GBps",
+                      "hbm_utilization_vs_v5e_peak")
             if k in companion
         }
         main["serialization_on"]["config"] = (

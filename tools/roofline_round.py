@@ -33,7 +33,6 @@ import time
 
 N = int(os.environ.get("ROOFLINE_N", "100000"))
 ROUNDS = int(os.environ.get("ROOFLINE_ROUNDS", "2000"))
-V5E_HBM_BYTES_S = 819e9
 V5E_BF16_FLOPS = 197e12
 
 
@@ -45,7 +44,7 @@ def main() -> int:
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     os.environ["BENCH_N"] = str(N)  # bench reads its N at import time
-    from bench import _cfg, _measure
+    from bench import V5E_HBM_BYTES_S, _cfg, _measure
 
     cfg = _cfg(ROUNDS)
     from blockchain_simulator_tpu.runner import make_sim_fn, use_round_schedule
@@ -63,7 +62,7 @@ def main() -> int:
     flops = float(ca.get("flops", 0.0))
     bytes_acc = float(ca.get("bytes accessed", 0.0))
 
-    value, rounds_done, wall, compile_s = _measure(cfg, batch=1)
+    value, rounds_done, wall, compile_s, _ = _measure(cfg, batch=1)
     per_round_s = wall / max(rounds_done, 1)
     bytes_per_round = bytes_acc / ROUNDS
     flops_per_round = flops / ROUNDS

@@ -73,7 +73,7 @@ def main() -> int:
 
     for n in NS:
         bench.N_NODES = n  # bench._cfg reads the module global
-        value, rounds_done, wall, compile_s = bench._measure(
+        value, rounds_done, wall, compile_s, _ = bench._measure(
             bench._cfg(ROUNDS), batch=1)
         pt = {
             "n": n,

@@ -197,6 +197,24 @@ def test_queued_links_jax_raft_matches_cpp():
     assert mj["last_block_ms"] >= const["last_block_ms"]
 
 
+def test_queued_links_jax_raft_sharded_matches_unsharded():
+    # the queued raft ack path routes per-destination ack ticks through a
+    # [D] histogram psum'd across shards into the leader's ring column —
+    # the sharded run must reproduce the single-device milestones
+    from blockchain_simulator_tpu.parallel.mesh import make_mesh
+    from blockchain_simulator_tpu.parallel.shard import run_sharded
+
+    cfg = SimConfig(protocol="raft", n=16, sim_ms=5000, queued_links=True)
+    single = run_simulation(cfg)
+    sharded = run_sharded(cfg, make_mesh(n_node_shards=4))
+    assert sharded["n_leaders"] == single["n_leaders"] == 1
+    assert sharded["agreement_ok"] and single["agreement_ok"]
+    # per-shard delay draws are decorrelated; block progression must agree
+    # closely (the 54 ms serialization cadence dominates, not the draws)
+    assert abs(sharded["blocks"] - single["blocks"]) <= 2
+    assert sharded["blocks"] >= 40
+
+
 def test_queued_links_jax_raft_zero_ser_is_identical():
     # serialization off -> ser = 0 -> the queued flag is a bit-exact no-op
     cfg = SimConfig(protocol="raft", n=8, sim_ms=4000,

@@ -24,17 +24,23 @@ Semantics match models/pbft.step for every configuration this path accepts
 view-change draw (same PRNG channel at the block tick), same metrics
 surface; delivery randomness is drawn per round instead of per tick, so
 results are distributionally — not bit — identical to the tick engine
-(delivery="stat" is already an aggregate model).  Precisely: per-slot
-COUNTS (commits, proposals, view changes — every milestone) are bit-equal,
-because both samplers deliver every message exactly once; per-slot commit
-*ticks* carry +/-1-tick tail jitter (the last threshold-crossing arrival
-falls in a different multinomial bucket under different keys).  Tests pin
-exactly that contract (tests/test_pbft_round.py).
+(delivery="stat" is already an aggregate model).  Precisely, for DROP-FREE
+configs: per-slot COUNTS (commits, proposals, view changes — every
+milestone) are bit-equal, because both samplers deliver every message
+exactly once; per-slot commit *ticks* carry +/-1-tick tail jitter (the
+last threshold-crossing arrival falls in a different multinomial bucket
+under different keys).  With drop_prob > 0 the thinning draws are
+independent between engines, so counts agree only where thresholds make
+the outcome deterministic (the drop tests pin such operating points, not
+exact equality at intermediate rates).  Tests pin exactly these contracts
+(tests/test_pbft_round.py).
 
 Eligibility (checked statically from the config):
 - protocol "pbft", topology "full", delivery "stat";
-- no per-message drops (with drops, leader belief can diverge between nodes
-  and rounds stop being single-proposer);
+- per-message drops only with view changes disabled (each wave is then an
+  independently thinned binomial, the tick engine's own stat-channel drop
+  model; a dropped VIEW_CHANGE would diverge leader beliefs and rounds
+  would stop being single-proposer);
 - no byz_forge flood (targets the exact-window tick machine);
 - the message horizon (including the constant block-serialization latency
   when modeled) must fit inside one block interval:
@@ -71,6 +77,7 @@ from flax import struct
 from blockchain_simulator_tpu.models import pbft as pbft_tick
 from blockchain_simulator_tpu.models.base import fault_masks
 from blockchain_simulator_tpu.ops import delay as delay_ops
+from blockchain_simulator_tpu.ops import delivery as dv
 from blockchain_simulator_tpu.ops.delivery import _global_ids, _shard_key
 from blockchain_simulator_tpu.utils.prng import Channel, chan_key
 
@@ -92,7 +99,8 @@ class PbftRoundState:
     next_n: jax.Array        # [N]
     rounds_sent: jax.Array   # [N]
     block_num: jax.Array     # [N]
-    unattributed: jax.Array  # [N] (always 0 on this path: no drops)
+    unattributed: jax.Array  # [N] (always 0 on this path: no vote table
+    # windows exist to misattribute into, even under drops)
     view_changes: jax.Array  # [N]
     alive: jax.Array         # [N]
     honest: jax.Array        # [N]
@@ -115,7 +123,22 @@ def eligible(cfg) -> bool:
         cfg.protocol == "pbft"
         and cfg.topology == "full"
         and cfg.delivery == "stat"
-        and cfg.faults.drop_prob == 0.0
+        # drops are fine while the leader never changes: every wave is
+        # independently thinned (same binomial model as the tick engine's
+        # stat channels).  With view changes enabled, a dropped VIEW_CHANGE
+        # diverges leader beliefs and rounds stop being single-proposer —
+        # that combination stays on the tick engine.  Windowed mode also
+        # stays there: a pp-dropped receiver's commit crossing lands in the
+        # tick engine's stale-tenant/unattributed bookkeeping, which this
+        # path (no vote table) cannot reproduce; exact mode credits by
+        # window identity in both engines.
+        and (
+            cfg.faults.drop_prob == 0.0
+            or (
+                cfg.pbft_view_change_num == 0
+                and pbft_tick.eff_window(cfg) >= cfg.pbft_max_slots
+            )
+        )
         and not cfg.faults.byz_forge
         and not cfg.queued_links  # serial-pipe backlog is cross-round state
         and ser + max_arrival_offset(cfg) < cfg.pbft_block_interval_ms
@@ -250,14 +273,22 @@ def step_round(cfg, state: PbftRoundState, r, key):
     k_pp = chan_key(tkey, Channel.DELAY_BCAST2)
     d_j = jax.random.randint(_shard_key(k_pp, axis), (n_loc,), lo, hi, jnp.int32)
     recv = active & state.alive & ~send & (t0 + ser + d_j < t_end)
+    drop = cfg.faults.drop_prob
+    if drop > 0.0:
+        recv = recv & jax.random.bernoulli(
+            _shard_key(jax.random.fold_in(k_pp, 0x0D0D), axis),
+            1.0 - drop, (n_loc,),
+        )
     # every receiver broadcasts PREPARE on arrival; honest alive peers reply
     # SUCCESS (short-circuited round trip, pbft-node.cc:212-221)
     voters = state.alive & state.honest
     n_voters = _psum(voters.astype(jnp.int32).sum(), axis)
     k_rt = chan_key(tkey, Channel.DELAY_ROUNDTRIP)
-    m_replies = jnp.where(recv, n_voters - voters.astype(jnp.int32), 0)
-    rt_counts = delay_ops.sample_bucket_counts(
-        _shard_key(k_rt, axis), m_replies, rt_probs, smode
+    # the tick engine's own stat round-trip helper: per-receiver reply
+    # counts with (1-p)^2 two-leg thinning under drops
+    rt_counts = dv.roundtrip_reply_counts_stat(
+        k_rt, recv, n_voters - voters.astype(jnp.int32), rt_probs, drop,
+        axis=axis, mode=smode,
     )  # [B2, N] reply counts, bucket k -> tick t0 + ser + d_j + rt_lo + k
     rt_land = (t0 + ser + d_j[None, :] + rt_lo + jnp.arange(b2)[:, None]) < t_end
     rt_counts = rt_counts * rt_land.astype(jnp.int32)
@@ -289,6 +320,11 @@ def step_round(cfg, state: PbftRoundState, r, key):
     k_cm = chan_key(tkey, Channel.DELAY_BCAST)
     w_arr = w_send + b1 - 1
     m_all = jnp.where(state.alive[None, :], totals[:, None] - send_at, 0)
+    if drop > 0.0:
+        m_all = jnp.round(delay_ops.binom(
+            _shard_key(jax.random.fold_in(k_cm, 0x0D12), axis),
+            m_all, 1.0 - drop, smode,
+        )).astype(jnp.int32)
     cnt_all = delay_ops.sample_bucket_counts(
         _shard_key(k_cm, axis), m_all, ow_probs, smode
     )  # [b1, w_send, N]

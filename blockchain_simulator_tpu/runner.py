@@ -32,10 +32,10 @@ def use_round_schedule(cfg: SimConfig) -> bool:
         if not ok:
             raise ValueError(
                 "schedule='round' requires pbft + full mesh + stat delivery "
-                "with no drops, no byz_forge, and a message horizon — "
-                "including the constant block-serialization latency when "
-                "modeled — inside one block interval "
-                "(models/pbft_round.eligible)"
+                "with no byz_forge, no queued links, drops only when view "
+                "changes are disabled, and a message horizon — including "
+                "the constant block-serialization latency when modeled — "
+                "inside one block interval (models/pbft_round.eligible)"
             )
         return True
     return ok and cfg.n >= 4096  # "auto"

@@ -124,9 +124,12 @@ class SimConfig:
     stat_sampler: str = "auto"
     # Stepping granularity of the simulation loop:
     # "tick"  — the general engine: one scan step per 1 ms tick (always valid).
-    # "round" — PBFT fast path: one scan step per 50 ms block interval
-    #           (models/pbft_round.py); requires full-mesh stat delivery with
-    #           no drops/forging/serialization so rounds are closed waves.
+    # "round" — PBFT fast path: one scan step per block interval
+    #           (models/pbft_round.py); requires full-mesh stat delivery, no
+    #           byz_forge/queued links, drops only with view changes off (and
+    #           the exact vote table), and the message wave — including the
+    #           constant serialization offset when modeled — closing inside
+    #           one block interval (pbft_round.eligible).
     # "auto"  — "round" when eligible and n >= 4096 (where the tick engine's
     #           per-tick ring traffic dominates), else "tick".
     schedule: str = "auto"

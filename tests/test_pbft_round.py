@@ -107,6 +107,34 @@ def test_truncated_final_wave_matches():
     assert int(np.abs(ct_t - ct_r)[done].max()) <= 1
 
 
+def test_drops_on_round_path_match_tick_engine():
+    # drops are eligible when view changes are off (single leader forever).
+    # Thinning draws are independent between engines, but the N/2 thresholds
+    # make moderate drops outcome-deterministic: p=0.05 keeps every wave far
+    # above quorum (~57 of the needed 32/33 votes) -> 40/40 in both engines;
+    # p=0.4 starves the prepare quorum (~23 expected replies) -> 0 in both.
+    for p, want in ((0.05, 40), (0.4, 0)):
+        kw = dict(**BASE, pbft_view_change_num=0,
+                  faults=FaultConfig(drop_prob=p))
+        tick, rnd = both(kw)
+        assert tick["blocks_final_all_nodes"] == want, p
+        assert rnd["blocks_final_all_nodes"] == want, p
+        assert rnd["rounds_sent"] == tick["rounds_sent"] == 40
+        assert rnd["agreement_ok"] and tick["agreement_ok"]
+        if want:
+            assert abs(rnd["mean_time_to_finality_ms"]
+                       - tick["mean_time_to_finality_ms"]) < 4
+    # drops + view changes stays on the tick engine
+    assert not use_round_schedule(
+        SimConfig(**BASE, faults=FaultConfig(drop_prob=0.05)).with_(n=8192))
+    # drops + windowed vote table too (the tick engine's stale-tenant /
+    # unattributed bookkeeping has no round-path counterpart)
+    assert not use_round_schedule(
+        SimConfig(**BASE, pbft_view_change_num=0,
+                  faults=FaultConfig(drop_prob=0.05)).with_(
+                      n=8192, pbft_window=8))
+
+
 def test_schedule_round_rejects_ineligible():
     with pytest.raises(ValueError, match="schedule='round'"):
         make_sim_fn(SimConfig(**BASE, schedule="round",

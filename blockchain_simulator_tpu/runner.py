@@ -33,9 +33,11 @@ def use_round_schedule(cfg: SimConfig) -> bool:
             raise ValueError(
                 "schedule='round' requires pbft + full mesh + stat delivery "
                 "with no byz_forge, no queued links, drops only when view "
-                "changes are disabled, and a message horizon — including "
-                "the constant block-serialization latency when modeled — "
-                "inside one block interval (models/pbft_round.eligible)"
+                "changes are disabled AND the vote table is exact "
+                "(pbft_window = 0 or >= pbft_max_slots), and a message "
+                "horizon — including the constant block-serialization "
+                "latency when modeled — inside one block interval "
+                "(models/pbft_round.eligible)"
             )
         return True
     return ok and cfg.n >= 4096  # "auto"

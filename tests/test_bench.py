@@ -1,0 +1,44 @@
+"""Driver contract of bench.py: exactly one parseable JSON line, rc 0.
+
+The driver runs ``python bench.py`` at the end of every round and records
+the LAST stdout line as the round's benchmark (BENCH_r{N}.json); rounds 1-4
+each hardened this contract after a failure mode (rc=124 with no output,
+SIGKILLed children, wedged-tunnel hangs).  This test pins the CPU-forced
+happy path end-to-end through the real parent: probe stage, ladder, result
+assembly with the timing-model statement."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_bench_emits_one_json_line_rc0():
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "BENCH_N": "4096",          # >= 4096: the round fast path
+        "BENCH_ROUNDS_FIRST": "50",
+        "BENCH_ROUNDS": "0",        # single-rung ladder
+        "BENCH_ROUNDS_SER": "0",    # no companion (keep the test fast)
+        "BENCH_DEADLINE_S": "240",
+    })
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True, text=True, env=env, timeout=260, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = proc.stdout.strip().splitlines()
+    assert lines, "bench printed nothing"
+    rec = json.loads(lines[-1])
+    assert rec["unit"] == "rounds/s"
+    assert rec["value"] > 0
+    assert 0 < rec["vs_baseline"] == round(rec["value"] / 1000.0, 4)
+    assert rec["backend"] == "cpu"
+    assert "timing_model" in rec
+    assert rec["probe_s"] is not None

@@ -210,6 +210,17 @@ def test_serialization_truncated_wave_matches():
         assert rnd[k] == tick[k], k
 
 
+def test_milestones_match_across_seeds():
+    # the bit-equal milestone contract must hold for EVERY seed, not the
+    # default one — a seed-dependent divergence (e.g. a view-change pattern
+    # only some keys produce) would slip past the single-seed pins above
+    for seed in (1, 7, 23, 1217):
+        kw = dict(**BASE, seed=seed)
+        tick, rnd = both(kw)
+        for k in MILESTONES:
+            assert rnd[k] == tick[k], (seed, k)
+
+
 def test_exact_sampler_round_mode():
     # stat_sampler="exact" must work on the fast path too (auto picks normal
     # only at large n; force both and compare milestones)

@@ -46,9 +46,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--delivery", choices=["edge", "stat"], default=d.delivery)
     p.add_argument("--schedule", choices=["tick", "round", "auto"],
                    default=d.schedule,
-                   help="tick = general 1ms-tick engine; round = PBFT "
-                        "round-blocked fast path (validated); auto = round "
-                        "when eligible and n >= 4096")
+                   help="tick = general 1ms-tick engine; round = phase-"
+                        "blocked fast path (PBFT: one step per block "
+                        "interval; raft: per heartbeat with a checked "
+                        "election handoff); auto = round when eligible and "
+                        "n >= 4096")
     p.add_argument("--stat-sampler", choices=["exact", "normal", "auto"],
                    default=d.stat_sampler,
                    help="binomial sampler for stat-delivery bucket counts: "

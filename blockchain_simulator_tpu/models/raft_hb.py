@@ -1,0 +1,276 @@
+"""Raft heartbeat-blocked fast path: one scan step = one 50 ms heartbeat.
+
+The raft tick engine (models/raft.py) carries [N] state and [D, N] rings
+through every 1 ms tick.  But steady-state raft replication is LEADER-
+CENTRIC: one proposal broadcast per heartbeat, N-1 acks back, a majority
+count — the followers are homogeneous (clean fidelity: ack unconditionally,
+store the value, re-arm the timer).  Aggregated, a whole heartbeat is O(1)
+work — a handful of scalar bucket draws and a short crossing loop —
+INDEPENDENT OF N: the same multi-rate-stepping-to-the-limit design as the
+PBFT round path (models/pbft_round.py), taken further because raft's steady
+state has a single actor.
+
+Two phases under one jit:
+
+1. **Election prefix** (tick engine, ``prefix_ticks(cfg)`` = election_hi +
+   2*roundtrip_hi ticks): elections are genuinely event-driven (randomized
+   timers, races, retries), so the faithful tick machine runs them.  At the
+   handoff the program CHECKS it reached the quiet window between the
+   election settling and the first proposal (exactly one leader, its vote
+   wave drained, proposals not yet started) and emits an ``ok`` flag; the
+   runner falls back to the full tick engine when the flag is false (e.g. a
+   split first election that re-ran past the prefix) — the fast path is
+   never silently wrong.
+2. **Heartbeat scan**: per step, the leader's proposal (once
+   ``proposal_tick`` passes), its ack wave as multinomial bucket counts over
+   the round-trip distribution offset by the 20 KB serialization time, and
+   the clean-mode ack-window bookkeeping at BIN granularity with the tick
+   engine's exact ordering: arrivals on the heartbeat boundary tick count
+   into the OLD window, then the new proposal resets it, then later
+   arrivals fill the new one.  With the reference's 54-tick proposal
+   serialization the whole wave lands one heartbeat behind its proposal —
+   reproducing the tick engine's characteristic "49 of 50 blocks at
+   defaults" pipeline (see .claude/skills/verify/SKILL.md).
+
+Timer suppression is structural: heartbeats every 50 ms re-arm 150-300 ms
+election timers, so in the fault classes this path accepts (crash/Byzantine
+from t=0, no drops) no election can fire after the handoff.
+
+Milestone contract vs the tick engine (same reasoning as pbft_round): ack
+COUNTS are deterministic (no drops — every follower acks every proposal
+exactly once), so per-block commit counts are bit-equal; commit TICKS carry
+the +/-1 bucket-quantile jitter of the independent per-engine draws.
+
+Documented divergence — post-completion election churn: when replication
+finishes INSIDE the window (blockNum hits raft_max_blocks), the reference
+cancels the heartbeat (raft-node.cc:248-251); in clean fidelity the silenced
+heartbeat un-suppresses every follower's election timer and the tick engine
+then churns elections for the rest of the window (a real consequence of
+completion silencing the failure detector; the gossip overlay keeps a
+control heartbeat for exactly this reason — models/raft.py).  This path ends
+at completion instead: every consensus milestone (leader, blocks, block
+ticks, rounds, agreement over the replicated log) is identical — the churn
+starts only after the log is complete — but the ``elections`` metric counts
+the consensus phase only, and post-completion re-leaders are not simulated.
+Configurations whose window ends before completion (e.g. the reference
+default, where serialized acks leave 49/50 blocks at the 10 s mark) have no
+churn phase and match on every metric including ``elections``.
+
+Reference anchors: sendHeartBeat/SendTX (raft-node.cc:405-433,340-365), ack
+counting + blockNum (raft-node.cc:234-251), setProposal (+1 s, :216,433),
+stop conditions (:248-251, :361-365).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from blockchain_simulator_tpu.models import raft as raft_tick
+from blockchain_simulator_tpu.ops import delay as delay_ops
+from blockchain_simulator_tpu.utils import prng
+from blockchain_simulator_tpu.utils.prng import Channel, chan_key
+
+DISARM = raft_tick.DISARM
+
+
+def prefix_ticks(cfg) -> int:
+    """Static election-phase length: the last possible first-attempt election
+    fires by election_hi; its request+reply wave drains within 2 round trips."""
+    _, rt_hi = cfg.roundtrip_range()
+    return cfg.raft_election_hi_ms + 2 * rt_hi
+
+
+def eligible(cfg) -> bool:
+    return (
+        cfg.protocol == "raft"
+        and cfg.fidelity == "clean"  # reference mode never re-arms timers and
+        # gates commits on exactly N-1 replies — tick-machine territory
+        and cfg.topology == "full"
+        and cfg.delivery == "stat"
+        and cfg.faults.drop_prob == 0.0  # a dropped ack changes counts; a
+        # dropped heartbeat un-suppresses a timer (re-election mid-stream)
+        and not cfg.queued_links
+        and cfg.raft_heartbeat_ms < cfg.raft_election_lo_ms  # timer suppression
+        and cfg.sim_ms > prefix_ticks(cfg) + cfg.raft_heartbeat_ms
+    )
+
+
+def _ack_bins(cfg):
+    """Static (bin -> step offset, tick-within-step, boundary flag) layout of
+    the ack round-trip distribution shifted by the proposal serialization."""
+    rt_lo, rt_hi = cfg.roundtrip_range()
+    ser = cfg.serialization_ticks(cfg.raft_block_bytes)
+    hb = cfg.raft_heartbeat_ms
+    offs = [ser + rt_lo + b for b in range(rt_hi - rt_lo)]
+    return [(o // hb, o % hb) for o in offs]
+
+
+def make_fast_fn(cfg):
+    """Build ``fast(key) -> (RaftState, ok)`` — tick-engine election prefix,
+    checked handoff, heartbeat-blocked steady-state scan."""
+    hb = cfg.raft_heartbeat_ms
+    t_e = prefix_ticks(cfg)
+    n = cfg.n
+    b_max = cfg.raft_max_blocks
+    bins = _ack_bins(cfg)
+    b2 = len(bins)
+    span = max(s for s, _ in bins) + 1
+    # bin processing order within a step: tick-within-step ascending; ties by
+    # bin index (same tick => one counter update, order irrelevant)
+    order = sorted(range(b2), key=lambda i: bins[i][1])
+    k_steps = max((cfg.ticks - t_e) // hb + 2, 1)
+    rt_probs = delay_ops.roundtrip_probs(*cfg.one_way_range())
+    smode = cfg.eff_stat_sampler
+    need = cfg.majority_need
+
+    @jax.jit
+    def fast(key):
+        # ---- phase 1: election prefix on the tick engine -------------------
+        state, bufs = raft_tick.init(cfg, jax.random.fold_in(key, 0x1217))
+
+        def tick_body(carry, t):
+            st, bf = carry
+            st, bf = raft_tick.step(cfg, st, bf, t, prng.tick_key(key, t))
+            return (st, bf), ()
+
+        (state, _), _ = jax.lax.scan(tick_body, (state, bufs), jnp.arange(t_e))
+
+        # ---- handoff check: the quiet pre-proposal window ------------------
+        lead_mask = state.is_leader & state.alive
+        n_leaders = lead_mask.sum()
+        lead = jnp.argmax(lead_mask)  # valid iff n_leaders == 1
+        rt_hi = cfg.roundtrip_range()[1]
+        ok = (
+            (n_leaders == 1)
+            # the election wave has fully drained: stale grants/denials land
+            # within one round trip of the winning fire (leader_tick is the
+            # win tick, itself at most rt_hi past the fire — prefix_ticks
+            # budgets 2*rt_hi past election_hi for exactly this)
+            & (state.leader_tick[lead] + rt_hi <= t_e)
+            & (state.proposal_tick[lead] > t_e + hb)  # not yet proposing
+        )
+
+        # ---- phase 2: heartbeat-blocked scan -------------------------------
+        ok_cnt = (
+            (state.alive & state.honest).sum()
+            - jnp.where(state.honest[lead], 1, 0)
+        ).astype(jnp.float32)  # honest alive followers (SUCCESS acks)
+        hb0 = state.next_hb[lead]
+        p_start = state.proposal_tick[lead]
+
+        def hb_body(carry, k):
+            pend, hs, open_, bn, rnd, add_on, stopped, bt = carry
+            t_k = hb0 + k * hb
+
+            def apply_bin(cnt, tick, hs, open_, bn, bt):
+                """One ack bin through the window: count, threshold-cross,
+                commit (clean latch) — the tick engine's per-tick rule."""
+                hs = hs + cnt
+                crossed = open_ & (cnt > 0) & (hs + 1 >= need)
+                blk = jnp.clip(bn, 0, b_max - 1)
+                bt = jnp.where(
+                    jax.nn.one_hot(blk, b_max, dtype=bool)
+                    & crossed & (bn < b_max),
+                    tick, bt,
+                )
+                return hs, open_ & ~crossed, bn + crossed, bt
+
+            arrivals = pend[0]  # [B2] counts landing this step
+            # boundary-tick arrivals (tick offset 0) hit the OLD window and
+            # are fully folded — including into bn — BEFORE the proposal
+            # gate below, matching the tick engine's within-tick order
+            # (arrival processing, then the heartbeat timer section)
+            for i in order:
+                s_i, off_i = bins[i]
+                if off_i != 0:
+                    continue
+                # horizon mask: arrivals at or past the window end never land
+                cnt = jnp.where(t_k + off_i < cfg.ticks, arrivals[i], 0)
+                hs, open_, bn, bt = apply_bin(cnt, t_k + off_i,
+                                              hs, open_, bn, bt)
+            # heartbeat boundary: proposal + clean window reset
+            # (raft-node.cc:405-433; raft.py step's timer section); a
+            # boundary-tick commit that just hit b_max cancels it
+            live = (t_k < cfg.ticks) & ~stopped
+            p = live & (t_k >= p_start) & add_on & (bn < b_max)
+            rnd = rnd + p
+            add_on = add_on & ~(p & (rnd >= cfg.raft_max_rounds))
+            hs = jnp.where(p, 0, hs)
+            open_ = open_ | p
+            # post-boundary arrivals fill the (possibly new) window
+            for i in order:
+                s_i, off_i = bins[i]
+                if off_i == 0:
+                    continue
+                cnt = jnp.where(t_k + off_i < cfg.ticks, arrivals[i], 0)
+                hs, open_, bn, bt = apply_bin(cnt, t_k + off_i,
+                                              hs, open_, bn, bt)
+            # rotate the pending ring and enqueue this proposal's ack wave
+            pend = jnp.concatenate(
+                [pend[1:], jnp.zeros((1, b2), jnp.int32)], axis=0
+            )
+            cnts = delay_ops.sample_bucket_counts(
+                jax.random.fold_in(chan_key(prng.tick_key(key, t_k),
+                                            Channel.DELAY_ROUNDTRIP), 0x4B),
+                jnp.where(p, ok_cnt, 0.0), rt_probs, smode,
+            )  # [B2] scalar counts
+            for i in range(b2):
+                s_i, _ = bins[i]
+                if s_i > 0:  # lands s_i steps later: row s_i-1 post-rotation
+                    pend = pend.at[s_i - 1, i].add(cnts[i])
+            # s_i == 0 bins (ser + rt < heartbeat) land later THIS step,
+            # which the rotated ring's row 0 has already passed — inject
+            # them directly (offsets are > 0: acks always land strictly
+            # after their proposal tick)
+            if any(s == 0 for s, _ in bins):
+                for i in order:
+                    s_i, off_i = bins[i]
+                    if s_i != 0:
+                        continue
+                    cnt = jnp.where(t_k + off_i < cfg.ticks, cnts[i], 0)
+                    hs, open_, bn, bt = apply_bin(cnt, t_k + off_i,
+                                                  hs, open_, bn, bt)
+            stopped = stopped | (bn >= b_max)  # blockNum>=50 cancels the
+            # heartbeat (raft-node.cc:248-251)
+            return (pend, hs, open_, bn, rnd, add_on, stopped, bt), ()
+
+        carry0 = (
+            jnp.zeros((span, b2), jnp.int32),
+            jnp.int32(0),                       # hs (ack window count)
+            jnp.bool_(False),                   # hb_open
+            state.block_num[lead],              # 0 at handoff
+            state.round[lead],                  # 0 at handoff
+            jnp.bool_(True),                    # add_change_value (will set)
+            jnp.bool_(False),                   # stopped
+            state.block_tick[lead],             # [B] commit ticks
+        )
+        (_, hs, open_, bn, rnd, add_on, stopped, bt), _ = jax.lax.scan(
+            hb_body, carry0, jnp.arange(k_steps)
+        )
+
+        # ---- materialize the [N] state the metrics surface reads -----------
+        onehot = jax.nn.one_hot(lead, n, dtype=bool)
+        state = state.replace(
+            block_num=jnp.where(onehot, bn, state.block_num),
+            round=jnp.where(onehot, rnd, state.round),
+            block_tick=jnp.where(onehot[:, None], bt[None, :],
+                                 state.block_tick),
+            hb_succ=jnp.where(onehot, hs, state.hb_succ),
+            hb_open=jnp.where(onehot, open_, state.hb_open),
+            add_change_value=jnp.where(onehot, add_on, state.add_change_value),
+            next_hb=jnp.where(onehot & stopped, DISARM, state.next_hb),
+            # every alive follower stored the leader's proposal value once
+            # replication ran (m_value = leader id, raft-node.cc:180-190)
+            m_value=jnp.where(
+                state.alive & ~onehot & (rnd > 0), lead, state.m_value
+            ),
+        )
+        return state, ok
+
+    return fast
+
+
+def metrics(cfg, state) -> dict:
+    return raft_tick.metrics(cfg, state)

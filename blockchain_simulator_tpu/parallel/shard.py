@@ -107,14 +107,28 @@ def make_sharded_sim_fn(cfg: SimConfig, mesh: Mesh):
     """Jitted ``sim(key) -> final_state`` with node state sharded over the
     mesh's ``nodes`` axis.  ``cfg.n`` must divide by the axis size.
 
-    Resolves ``cfg.schedule`` exactly like runner.make_sim_fn: the PBFT
-    round-blocked fast path when eligible ('round' explicit, or 'auto' at
-    n >= 4096), else the general per-tick engine."""
+    Schedule resolution: the PBFT round-blocked fast path when eligible
+    ('round' explicit, or 'auto' at n >= 4096), else the general per-tick
+    engine.  Raft differs from runner.make_sim_fn here: its heartbeat fast
+    path (models/raft_hb.py) is O(1) per step and single-chip by design, so
+    sharded raft always runs the tick engine ('round' explicit raises)."""
     from blockchain_simulator_tpu.runner import _reject_cpp_only, use_round_schedule
 
     _reject_cpp_only(cfg)
     if use_round_schedule(cfg):
-        return _make_sharded_round_fn(cfg, mesh)
+        if cfg.protocol == "raft":
+            # the raft heartbeat fast path is O(1) per step (leader-centric
+            # aggregation, models/raft_hb.py) — sharding it is meaningless;
+            # sharded raft always runs the tick engine
+            if cfg.schedule == "round":
+                raise ValueError(
+                    "schedule='round' (heartbeat fast path) is single-chip "
+                    "for raft — its steady state is O(1) per step; use "
+                    "schedule='tick'/'auto' for sharded raft"
+                )
+            cfg = cfg.with_(schedule="tick")
+        else:
+            return _make_sharded_round_fn(cfg, mesh)
     n_shards = mesh.shape[NODES_AXIS]
     proto = get_protocol(cfg.protocol)
     cfg_local = cfg.with_(mesh_axis=NODES_AXIS)

@@ -23,6 +23,11 @@ def run_seed_sweep(cfg: SimConfig, seeds, mesh=None):
     """Run ``len(seeds)`` simulations of one config in a single vmapped
     program; returns a list of per-seed metrics dicts."""
     proto = get_protocol(cfg.protocol)
+    if cfg.protocol == "raft":
+        # the raft heartbeat fast path's checked handoff branches on the
+        # host (runner.make_sim_fn sim_hb) and cannot be vmapped; sweeps
+        # always run raft on the (fully traceable) tick engine
+        cfg = cfg.with_(schedule="tick")
     if mesh is not None:
         n_sweep = mesh.shape[SWEEP_AXIS]
         if len(seeds) % n_sweep != 0:

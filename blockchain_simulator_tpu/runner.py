@@ -22,8 +22,25 @@ from blockchain_simulator_tpu.utils.sync import force_sync
 
 
 def use_round_schedule(cfg: SimConfig) -> bool:
-    """Resolve cfg.schedule: does this config run the round-blocked fast path?"""
-    if cfg.protocol != "pbft" or cfg.schedule == "tick":
+    """Resolve cfg.schedule: does this config run a phase-blocked fast path
+    (PBFT: one scan step per block interval; raft: per heartbeat)?"""
+    if cfg.schedule == "tick":
+        return False
+    if cfg.protocol == "raft":
+        from blockchain_simulator_tpu.models import raft_hb
+
+        ok = raft_hb.eligible(cfg)
+        if cfg.schedule == "round":
+            if not ok:
+                raise ValueError(
+                    "schedule='round' for raft requires clean fidelity + "
+                    "full mesh + stat delivery with no drops/queued links, "
+                    "heartbeat < election_lo, and a window longer than the "
+                    "election prefix (models/raft_hb.eligible)"
+                )
+            return True
+        return ok and cfg.n >= 4096  # "auto"
+    if cfg.protocol != "pbft":
         return False
     from blockchain_simulator_tpu.models import pbft_round
 
@@ -119,6 +136,23 @@ def make_sim_fn(cfg: SimConfig):
     """
     _reject_cpp_only(cfg)
     if use_round_schedule(cfg):
+        if cfg.protocol == "raft":
+            from blockchain_simulator_tpu.models import raft_hb
+
+            fast = raft_hb.make_fast_fn(cfg)
+            tick_cfg = cfg.with_(schedule="tick")
+
+            def sim_hb(key):
+                state, ok = fast(key)
+                if not bool(jax.device_get(ok)):
+                    # the election prefix did not reach the quiet handoff
+                    # window (e.g. a split first election re-ran past it):
+                    # the faithful tick engine takes over — the fast path is
+                    # checked, never silently wrong
+                    return make_sim_fn(tick_cfg)(key)
+                return state
+
+            return sim_hb
         from blockchain_simulator_tpu.models import pbft_round
 
         @jax.jit

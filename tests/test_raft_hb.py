@@ -1,0 +1,122 @@
+"""Raft heartbeat-blocked fast path (models/raft_hb.py) vs the tick engine.
+
+Same contract family as tests/test_pbft_round.py: the fast path must
+reproduce the tick engine's consensus milestones for every accepted
+configuration, with commit ticks inside the +/-1 bucket-quantile jitter.
+Post-completion election churn is a documented divergence (module
+docstring): ``elections`` is compared only where the window ends before
+replication completes.
+"""
+
+import pytest
+
+from blockchain_simulator_tpu.runner import (
+    make_sim_fn,
+    run_simulation,
+    use_round_schedule,
+)
+from blockchain_simulator_tpu.utils.config import FaultConfig, SimConfig
+
+BASE = dict(protocol="raft", n=16, sim_ms=10_000, delivery="stat")
+
+CONSENSUS = ("n_leaders", "leader", "leader_elected_ms", "blocks", "rounds",
+             "agreement_ok")
+
+
+def both(kw):
+    tick = run_simulation(SimConfig(**kw, schedule="tick"))
+    hb = run_simulation(SimConfig(**kw, schedule="round"))
+    return tick, hb
+
+
+def test_default_run_matches_tick_engine_exactly():
+    # reference defaults (serialized 20 KB proposals): the window ends at
+    # 49/50 blocks, so there is no churn phase and EVERY metric must agree
+    tick, hb = both(BASE)
+    for k in CONSENSUS + ("elections",):
+        assert hb[k] == tick[k], k
+    assert tick["blocks"] == 49  # acks one heartbeat window behind (ser=54)
+    assert abs(hb["last_block_ms"] - tick["last_block_ms"]) <= 1
+    assert abs(hb["mean_block_interval_ms"]
+               - tick["mean_block_interval_ms"]) <= 0.1
+
+
+def test_serialization_off_completes_and_matches():
+    # ser = 0: every ack bin lands inside its own heartbeat step (the
+    # same-step injection path) and replication completes mid-window —
+    # consensus milestones match; `elections` is churn-affected (docstring)
+    kw = {**BASE, "sim_ms": 6000, "model_serialization": False}
+    tick, hb = both(kw)
+    for k in CONSENSUS:
+        assert hb[k] == tick[k], k
+    assert hb["blocks"] == 50
+    assert abs(hb["last_block_ms"] - tick["last_block_ms"]) <= 1
+
+
+def test_crash_faults_match():
+    kw = {**BASE, "sim_ms": 8000, "faults": FaultConfig(n_crashed=5)}
+    tick, hb = both(kw)
+    for k in CONSENSUS:
+        assert hb[k] == tick[k], k
+    assert abs(hb["last_block_ms"] - tick["last_block_ms"]) <= 1
+
+
+def test_byzantine_acks_match():
+    # Byzantine followers flip SUCCESS acks to FAILED: the majority count
+    # sees only honest acks; with 4 liars of 16, 11 honest followers + self
+    # still clear the N/2+1 = 9 threshold in both engines
+    kw = {**BASE, "sim_ms": 8000, "faults": FaultConfig(n_byzantine=4)}
+    tick, hb = both(kw)
+    for k in CONSENSUS:
+        assert hb[k] == tick[k], k
+
+
+def test_byzantine_majority_falls_back_to_tick_engine():
+    # 9 liars of 16 flip election votes too: denials become grants and TWO
+    # candidates win (the no-terms split brain raft.metrics documents).  The
+    # handoff check sees n_leaders != 1, flags not-ok, and the runner falls
+    # back to the tick engine — so the 'fast path' result must be the tick
+    # engine's, bit for bit, on every metric (the checked-handoff contract:
+    # never silently wrong)
+    kw = {**BASE, "sim_ms": 6000, "faults": FaultConfig(n_byzantine=9)}
+    tick, hb = both(kw)
+    assert hb == tick
+    assert tick["n_leaders"] == 2
+    assert not tick["agreement_ok"]
+
+
+def test_milestones_match_across_seeds():
+    for seed in (3, 11, 42):
+        kw = dict(**BASE, seed=seed)
+        tick, hb = both(kw)
+        for k in CONSENSUS + ("elections",):
+            assert hb[k] == tick[k], (seed, k)
+
+
+def test_schedule_resolution_and_gates():
+    big = SimConfig(**{**BASE, "n": 8192})
+    assert use_round_schedule(big)                      # auto at n >= 4096
+    assert not use_round_schedule(SimConfig(**BASE))    # n < 4096 -> tick
+    assert use_round_schedule(SimConfig(**BASE, schedule="round"))
+    with pytest.raises(ValueError, match="raft"):
+        make_sim_fn(SimConfig(**{**BASE, "delivery": "edge"},
+                              schedule="round"))
+    with pytest.raises(ValueError, match="raft"):
+        make_sim_fn(SimConfig(**BASE, schedule="round",
+                              fidelity="reference"))
+    with pytest.raises(ValueError, match="raft"):
+        make_sim_fn(SimConfig(**BASE, schedule="round",
+                              faults=FaultConfig(drop_prob=0.01)))
+
+
+def test_sharded_raft_round_schedule_rejected():
+    from blockchain_simulator_tpu.parallel.mesh import make_mesh
+    from blockchain_simulator_tpu.parallel.shard import make_sharded_sim_fn
+
+    with pytest.raises(ValueError, match="single-chip"):
+        make_sharded_sim_fn(SimConfig(**BASE, schedule="round"),
+                            make_mesh(n_node_shards=4))
+    # auto at scale silently uses the tick engine sharded (no error)
+    sim = make_sharded_sim_fn(SimConfig(**{**BASE, "n": 8192, "sim_ms": 600}),
+                              make_mesh(n_node_shards=4))
+    assert sim is not None

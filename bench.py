@@ -199,6 +199,18 @@ def child() -> None:
     stage against BENCH_CHILD_DEADLINE_S and exits 0 cleanly when the
     remaining budget cannot fit the next stage, so the parent never needs to
     kill this process in the normal path (KNOWN_ISSUES.md #3)."""
+    # XLA:CPU's intra-op thread pool HURTS at fallback scale: the 10k-node
+    # round step is ~70k-element ops, where cross-core synchronization costs
+    # more than the split saves (measured 155 -> 203 rounds/s from pinning
+    # alone on the 2-core driver box).  Pin the CPU-forced child to one core
+    # BEFORE any backend threads spawn; BENCH_CPU_PIN=0 disables.
+    if (os.environ.get("JAX_PLATFORMS") == "cpu"
+            and os.environ.get("BENCH_CPU_PIN", "1") != "0"):
+        try:
+            os.sched_setaffinity(0, {min(os.sched_getaffinity(0))})
+        except (AttributeError, OSError, ValueError):
+            pass
+
     import jax
 
     child_deadline = time.monotonic() + float(

@@ -48,9 +48,10 @@ def build_parser() -> argparse.ArgumentParser:
                    default=d.schedule,
                    help="tick = general 1ms-tick engine; round = phase-"
                         "blocked fast path (PBFT: one step per block "
-                        "interval; raft: per heartbeat with a checked "
-                        "election handoff); auto = round when eligible and "
-                        "n >= 4096")
+                        "interval; raft: per heartbeat behind a traced "
+                        "checked election handoff; mixed: the heartbeat "
+                        "scan inside every raft shard); auto = round when "
+                        "eligible and n >= 4096 (mixed: whenever eligible)")
     p.add_argument("--stat-sampler", choices=["exact", "normal", "auto"],
                    default=d.stat_sampler,
                    help="binomial sampler for stat-delivery bucket counts: "

@@ -34,6 +34,7 @@ import numpy as np
 from flax import struct
 
 from blockchain_simulator_tpu.models import pbft, raft
+from blockchain_simulator_tpu.utils import prng
 from blockchain_simulator_tpu.utils.config import FaultConfig
 
 
@@ -120,6 +121,102 @@ def step(cfg, state: MixedState, bufs: MixedBufs, t, tkey):
         pcfg, p_state, bufs.pbft, t, jax.random.fold_in(tkey, 0x9B9B)
     )
     return MixedState(raft=r_state, pbft=p_state), MixedBufs(raft=r_bufs, pbft=p_bufs)
+
+
+def fast_eligible(cfg) -> bool:
+    """Can the raft shards ride the heartbeat-blocked steady scan
+    (models/raft_hb.py)?  The shard sub-config must satisfy the same
+    eligibility as a standalone round-schedule raft — the shards ARE
+    standalone raft instances under the vmap."""
+    if cfg.protocol != "mixed":
+        return False
+    if cfg.n % cfg.mixed_shards != 0 or cfg.n // cfg.mixed_shards < 3:
+        return False  # init rejects these with a better message
+    from blockchain_simulator_tpu.models import raft_hb
+
+    rcfg, _ = sub_configs(cfg)
+    return raft_hb.eligible(rcfg)
+
+
+def scan_fast(cfg, state: MixedState, bufs: MixedBufs, key):
+    """Heartbeat-scheduled mixed simulation (BASELINE config 5's wall-clock
+    lever): run the full per-tick mixed engine for the raft election prefix,
+    evaluate the checked handoff (models/raft_hb.handoff) in EVERY shard,
+    then ``lax.cond`` on all-shards-quiet:
+
+    - fast branch: the S raft shards collapse to vmapped O(1)-per-heartbeat
+      steady scans (256 shards x 1k nodes stop paying 256k rows of per-tick
+      sampler work), while the S-representative PBFT layer — the only part
+      with genuine per-tick cross-shard dynamics — keeps stepping every tick
+      with its ``alive`` mask pinned all-true (every shard has a live,
+      undeposable leader post-handoff, which is exactly what the per-tick
+      engine would recompute).  PBFT keys/evolution are bit-identical to the
+      per-tick engine; raft milestones follow the raft_hb count contract.
+    - slow branch: any shard failed the handoff (split election, crashed
+      majority) — CONTINUE the per-tick mixed scan from the prefix carry,
+      bit-identical to an uninterrupted tick run.
+
+    Works unsharded, under vmap, and inside shard_map (cfg.mesh_axis row-
+    shards the shard axis; the handoff verdict is psum-agreed)."""
+    from blockchain_simulator_tpu.models import raft_hb
+
+    axis = cfg.mesh_axis
+    rcfg, pcfg = sub_configs(cfg)
+    t_e = raft_hb.prefix_ticks(rcfg)
+    s = cfg.mixed_shards
+
+    def tick_body(carry, t):
+        st, bf = carry
+        st, bf = step(cfg, st, bf, t, prng.tick_key(key, t))
+        return (st, bf), ()
+
+    carry, _ = jax.lax.scan(tick_body, (state, bufs), jnp.arange(t_e))
+    ok_s, h_s = jax.vmap(lambda st: raft_hb.handoff(rcfg, st))(carry[0].raft)
+    bad = (~ok_s).sum()
+    if axis is not None:
+        bad = jax.lax.psum(bad, axis)
+    ok_all = bad == 0
+
+    def fast_branch(carry):
+        st, bf = carry
+        s_loc = st.raft.block_num.shape[0]
+        base = 0 if axis is None else jax.lax.axis_index(axis) * s_loc
+        # per-shard steady-scan streams key on the GLOBAL shard id, so the
+        # sharded run is bit-identical to the single-device run (the same
+        # convention as step's per-tick shard keys)
+        hb_keys = jax.vmap(
+            lambda i: jax.random.fold_in(key, 0x4BB7 + base + i)
+        )(jnp.arange(s_loc))
+        res = jax.vmap(
+            lambda k, hh: raft_hb.steady_scan(rcfg, k, hh)
+        )(hb_keys, h_s)
+        raft_final = jax.vmap(
+            lambda rst, hh, r: raft_hb.materialize(rcfg, rst, hh, r)
+        )(st.raft, h_s, res)
+        ones = jnp.ones((s,), bool)
+
+        def p_body(pcarry, t):
+            ps, pb = pcarry
+            ps = ps.replace(alive=ones)
+            ps, pb = pbft.step(
+                pcfg, ps, pb, t,
+                jax.random.fold_in(prng.tick_key(key, t), 0x9B9B),
+            )
+            return (ps, pb), ()
+
+        (p_state, _), _ = jax.lax.scan(
+            p_body, (st.pbft, bf.pbft),
+            t_e + jnp.arange(max(cfg.ticks - t_e, 0)),
+        )
+        return MixedState(raft=raft_final, pbft=p_state)
+
+    def tick_branch(carry):
+        (st, _), _ = jax.lax.scan(
+            tick_body, carry, t_e + jnp.arange(max(cfg.ticks - t_e, 0))
+        )
+        return st
+
+    return jax.lax.cond(ok_all, fast_branch, tick_branch, carry)
 
 
 def metrics(cfg, state: MixedState) -> dict:

@@ -106,7 +106,6 @@ class PbftState:
     link_busy: jax.Array     # [N] tick until which (leader -> j) is busy
     ppq_tick: jax.Array      # [N, Q] queued-block arrival ticks (_NEVER free)
     ppq_val: jax.Array       # [N, Q] queued-block slot+1 values
-    ppq_w: jax.Array         # [N] FIFO write pointer
     # --- per-slot accumulators (GLOBAL_FIELDS; per-shard partials) ----------
     slot_commits: jax.Array      # [S] nodes that finalized slot s (first time)
     slot_commit_tick: jax.Array  # [S] last finalization tick, -1 never
@@ -129,15 +128,17 @@ def eff_window(cfg) -> int:
 
 
 def queue_len(cfg) -> int:
-    """Static per-destination block-FIFO depth for queued-link mode: at most
-    one block is sent per interval, and the backlog after R rounds is
-    R * max(0, ser - interval) ticks ≈ backlog/ser undelivered blocks."""
+    """Static per-destination block-FIFO depth for queued-link mode: sized to
+    r = min(pbft_max_rounds, pbft_max_slots) outright — cheap at the n=8-ish
+    scales queued fidelity runs at, and together with the free-slot enqueue
+    in ``step`` it makes silently clobbering an undelivered block impossible
+    (the former steady-state backlog estimate undersized the FIFO under
+    adversarial view-change timing, which both re-proposes stale slots and
+    resets link_busy — ADVICE r5)."""
     ser = cfg.serialization_ticks(cfg.pbft_block_bytes)
     if not cfg.queued_links or ser == 0:
         return 1  # dummy registers; the ring path carries the blocks
-    r = min(cfg.pbft_max_rounds, cfg.pbft_max_slots)
-    backlog = max(0, ser - cfg.pbft_block_interval_ms) * r
-    return min(r, backlog // ser + 3)
+    return min(cfg.pbft_max_rounds, cfg.pbft_max_slots)
 
 
 def init(cfg, key=None):
@@ -193,7 +194,6 @@ def init(cfg, key=None):
         link_busy=zi(n),
         ppq_tick=jnp.full((n, queue_len(cfg)), _NEVER, jnp.int32),
         ppq_val=zi(n, queue_len(cfg)),
-        ppq_w=zi(n),
         slot_commits=zi(s),
         slot_commit_tick=jnp.full((s,), -1, jnp.int32),
         slot_propose_tick=jnp.full((s,), _NEVER, jnp.int32),
@@ -504,13 +504,24 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
         start = jnp.maximum(link_at, link_busy)
         delivery = start + ser + prop
         link_busy = jnp.where(dest, start + ser, link_busy)
+        # enqueue into the first FREE slot (post-pop), never an occupied one:
+        # with the FIFO sized to min(max_rounds, max_slots) the occupancy —
+        # bounded by the serial-pipe backlog divided by ser, plus in-flight
+        # entries — can never fill it, so no undelivered block is ever
+        # silently clobbered (delivery matches on ppq_tick == t, so slot
+        # order is irrelevant)
         q = ppq_tick.shape[1]
-        oh_q = (jnp.arange(q)[None, :] == (state.ppq_w % q)[:, None]) & dest[:, None]
+        free = ppq_tick == _NEVER  # [N, Q]
+        first_free = jnp.argmax(free, axis=1)
+        oh_q = (
+            (jnp.arange(q)[None, :] == first_free[:, None])
+            & dest[:, None]
+            & free
+        )
         ppq_tick = jnp.where(oh_q, delivery[:, None], ppq_tick)
         ppq_val = jnp.where(oh_q, val_sent, state.ppq_val)
-        ppq_w = state.ppq_w + dest.astype(jnp.int32)
     else:
-        ppq_val, ppq_w = state.ppq_val, state.ppq_w
+        ppq_val = state.ppq_val
     if queued:
         pass  # blocks already enqueued on the serial pipes; ring untouched
     elif gossip:
@@ -604,7 +615,6 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
         link_busy=link_busy,
         ppq_tick=ppq_tick,
         ppq_val=ppq_val,
-        ppq_w=ppq_w,
         v=v,
         leader=leader,
         next_n=next_n,

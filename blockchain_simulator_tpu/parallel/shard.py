@@ -22,6 +22,23 @@ from blockchain_simulator_tpu.utils import prng
 from blockchain_simulator_tpu.utils.config import SimConfig
 
 
+def _shard_map(f, mesh, in_specs, out_specs):
+    """``shard_map`` across jax versions: ``jax.shard_map`` + ``check_vma``
+    on current releases, ``jax.experimental.shard_map`` + ``check_rep`` on
+    0.4.x.  Replication checking is waived either way: delivery ops mix
+    gathered (unreplicated) and replicated values; correctness is covered by
+    the sharded-vs-unsharded equivalence tests."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as sm
+
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_rep=False)
+
+
 def mixed_specs(state, bufs):
     """PartitionSpecs for the mixed shard-sim (models/mixed.py): raft leaves
     ``[S, ...]`` row-shard over the shard axis; the S-representative PBFT
@@ -86,12 +103,8 @@ def _make_sharded_round_fn(cfg: SimConfig, mesh: Mesh):
         state = pbft_round.scan_rounds(cfg_local, state, key)
         return pbft_round.finalize(state, NODES_AXIS)
 
-    shmapped = jax.shard_map(
-        run,
-        mesh=mesh,
-        in_specs=(P(), state_spec),
-        out_specs=state_spec,
-        check_vma=False,  # same waiver as the tick path below
+    shmapped = _shard_map(
+        run, mesh=mesh, in_specs=(P(), state_spec), out_specs=state_spec
     )
 
     @jax.jit
@@ -103,32 +116,92 @@ def _make_sharded_round_fn(cfg: SimConfig, mesh: Mesh):
 
 
 @functools.lru_cache(maxsize=64)
+def _make_sharded_raft_hb_fn(cfg: SimConfig, mesh: Mesh):
+    """Node-sharded heartbeat-blocked raft fast path (models/raft_hb.py):
+    the tick-engine election prefix runs sharded exactly like the general
+    engine; the checked handoff is a traced ``lax.cond`` whose predicate and
+    leader scalars are psum/pmax-agreed across the mesh, so every device
+    takes the same branch — either the replicated O(1) heartbeat scan (each
+    shard materializes only its local rows) or a continuation of the sharded
+    tick scan from the prefix carry."""
+    from blockchain_simulator_tpu.models import raft as raft_tick
+    from blockchain_simulator_tpu.models import raft_hb
+
+    n_shards = mesh.shape[NODES_AXIS]
+    if cfg.n % n_shards != 0:
+        raise ValueError(f"n={cfg.n} not divisible by {n_shards} node shards")
+    cfg_local = cfg.with_(mesh_axis=NODES_AXIS)
+
+    state0, bufs0 = jax.eval_shape(lambda: raft_tick.init(cfg, jax.random.key(0)))
+    state_spec, bufs_spec = node_specs(state0, bufs0)
+
+    def run(key, state, bufs):
+        return raft_hb.scan_from_init(cfg_local, state, bufs, key)
+
+    shmapped = _shard_map(
+        run, mesh=mesh, in_specs=(P(), state_spec, bufs_spec), out_specs=state_spec
+    )
+
+    @jax.jit
+    def sim(key):
+        state, bufs = raft_tick.init(cfg, jax.random.fold_in(key, 0x1217))
+        return shmapped(key, state, bufs)
+
+    return sim
+
+
+@functools.lru_cache(maxsize=64)
+def _make_sharded_mixed_fast_fn(cfg: SimConfig, mesh: Mesh):
+    """Shard-sharded heartbeat-scheduled mixed sim (models/mixed.scan_fast):
+    raft shard rows over the mesh axis, the S-representative PBFT layer
+    replicated, the per-shard handoff verdict psum-agreed."""
+    from blockchain_simulator_tpu.models import mixed
+
+    n_shards = mesh.shape[NODES_AXIS]
+    if cfg.mixed_shards % n_shards != 0:
+        raise ValueError(
+            f"mixed_shards={cfg.mixed_shards} not divisible by "
+            f"{n_shards} mesh shards"
+        )
+    cfg_local = cfg.with_(mesh_axis=NODES_AXIS)
+
+    state0, bufs0 = jax.eval_shape(lambda: mixed.init(cfg, jax.random.key(0)))
+    state_spec, bufs_spec = mixed_specs(state0, bufs0)
+
+    def run(key, state, bufs):
+        return mixed.scan_fast(cfg_local, state, bufs, key)
+
+    shmapped = _shard_map(
+        run, mesh=mesh, in_specs=(P(), state_spec, bufs_spec), out_specs=state_spec
+    )
+
+    @jax.jit
+    def sim(key):
+        state, bufs = mixed.init(cfg, jax.random.fold_in(key, 0x1217))
+        return shmapped(key, state, bufs)
+
+    return sim
+
+
+@functools.lru_cache(maxsize=64)
 def make_sharded_sim_fn(cfg: SimConfig, mesh: Mesh):
     """Jitted ``sim(key) -> final_state`` with node state sharded over the
     mesh's ``nodes`` axis.  ``cfg.n`` must divide by the axis size.
 
-    Schedule resolution: the PBFT round-blocked fast path when eligible
-    ('round' explicit, or 'auto' at n >= 4096), else the general per-tick
-    engine.  Raft differs from runner.make_sim_fn here: its heartbeat fast
-    path (models/raft_hb.py) is O(1) per step and single-chip by design, so
-    sharded raft always runs the tick engine ('round' explicit raises)."""
+    Schedule resolution matches runner.make_sim_fn: the PBFT round-blocked
+    fast path when eligible ('round' explicit, or 'auto' at n >= 4096), the
+    raft heartbeat fast path (traced checked handoff — the prefix runs on
+    the sharded tick engine, the steady scan is replicated O(1) work), the
+    heartbeat-scheduled mixed sim, else the general per-tick engine."""
     from blockchain_simulator_tpu.runner import _reject_cpp_only, use_round_schedule
 
     _reject_cpp_only(cfg)
     if use_round_schedule(cfg):
         if cfg.protocol == "raft":
-            # the raft heartbeat fast path is O(1) per step (leader-centric
-            # aggregation, models/raft_hb.py) — sharding it is meaningless;
-            # sharded raft always runs the tick engine
-            if cfg.schedule == "round":
-                raise ValueError(
-                    "schedule='round' (heartbeat fast path) is single-chip "
-                    "for raft — its steady state is O(1) per step; use "
-                    "schedule='tick'/'auto' for sharded raft"
-                )
-            cfg = cfg.with_(schedule="tick")
-        else:
-            return _make_sharded_round_fn(cfg, mesh)
+            return _make_sharded_raft_hb_fn(cfg, mesh)
+        if cfg.protocol == "mixed":
+            return _make_sharded_mixed_fast_fn(cfg, mesh)
+        return _make_sharded_round_fn(cfg, mesh)
     n_shards = mesh.shape[NODES_AXIS]
     proto = get_protocol(cfg.protocol)
     cfg_local = cfg.with_(mesh_axis=NODES_AXIS)
@@ -160,14 +233,9 @@ def make_sharded_sim_fn(cfg: SimConfig, mesh: Mesh):
             state = proto.finalize(state, NODES_AXIS)
         return state
 
-    shmapped = jax.shard_map(
-        run,
-        mesh=mesh,
-        in_specs=(P(), state_spec, bufs_spec),
+    shmapped = _shard_map(
+        run, mesh=mesh, in_specs=(P(), state_spec, bufs_spec),
         out_specs=state_spec,
-        check_vma=False,  # delivery ops mix gathered (unreplicated) and
-        # replicated values; correctness is covered by the
-        # sharded-vs-unsharded equivalence test
     )
 
     @jax.jit

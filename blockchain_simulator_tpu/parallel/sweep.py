@@ -23,11 +23,12 @@ def run_seed_sweep(cfg: SimConfig, seeds, mesh=None):
     """Run ``len(seeds)`` simulations of one config in a single vmapped
     program; returns a list of per-seed metrics dicts."""
     proto = get_protocol(cfg.protocol)
-    if cfg.protocol == "raft":
-        # the raft heartbeat fast path's checked handoff branches on the
-        # host (runner.make_sim_fn sim_hb) and cannot be vmapped; sweeps
-        # always run raft on the (fully traceable) tick engine
-        cfg = cfg.with_(schedule="tick")
+    # Every schedule is fully traceable — including round-schedule raft,
+    # whose checked handoff is a lax.cond (models/raft_hb.scan_from_init)
+    # that vmap lowers to a select: both branches run for the whole batch,
+    # so a batched round-schedule raft sweep costs about one tick-engine
+    # pass (the fallback branch continues the prefix carry, it does not
+    # restart), never more.
     if mesh is not None:
         n_sweep = mesh.shape[SWEEP_AXIS]
         if len(seeds) % n_sweep != 0:

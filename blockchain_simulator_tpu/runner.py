@@ -23,7 +23,8 @@ from blockchain_simulator_tpu.utils.sync import force_sync
 
 def use_round_schedule(cfg: SimConfig) -> bool:
     """Resolve cfg.schedule: does this config run a phase-blocked fast path
-    (PBFT: one scan step per block interval; raft: per heartbeat)?"""
+    (PBFT: one scan step per block interval; raft: per heartbeat; mixed: the
+    heartbeat scan inside every raft shard)?"""
     if cfg.schedule == "tick":
         return False
     if cfg.protocol == "raft":
@@ -40,6 +41,24 @@ def use_round_schedule(cfg: SimConfig) -> bool:
                 )
             return True
         return ok and cfg.n >= 4096  # "auto"
+    if cfg.protocol == "mixed":
+        from blockchain_simulator_tpu.models import mixed
+
+        ok = mixed.fast_eligible(cfg)
+        if cfg.schedule == "round":
+            if not ok:
+                raise ValueError(
+                    "schedule='round' for the mixed sim requires its raft "
+                    "shards to be heartbeat-schedulable: clean fidelity + "
+                    "stat delivery with no drops/queued links and a window "
+                    "longer than the election prefix (models/raft_hb.eligible "
+                    "on the shard sub-config)"
+                )
+            return True
+        # "auto": no n-threshold — the handoff is checked per shard and the
+        # fallback CONTINUES the tick scan from the prefix carry, so the
+        # fast path is never slower than the tick engine it replaces
+        return ok
     if cfg.protocol != "pbft":
         return False
     from blockchain_simulator_tpu.models import pbft_round
@@ -129,30 +148,32 @@ def _reject_cpp_only(cfg: SimConfig) -> None:
 def make_sim_fn(cfg: SimConfig):
     """Build (and cache) the jitted end-to-end simulation function for a config.
 
-    Returns ``sim(key) -> final_state`` running ``cfg.ticks`` ticks — either
-    the general per-tick engine or, when the config resolves to it, the
-    round-blocked PBFT fast path (one scan step per 50 ms block interval,
-    models/pbft_round.py).
+    Returns ``sim(key) -> final_state`` running ``cfg.ticks`` ticks — the
+    general per-tick engine or, when the config resolves to it, a phase-
+    blocked fast path: round-blocked PBFT (one scan step per 50 ms block
+    interval, models/pbft_round.py), heartbeat-blocked raft behind a traced
+    checked handoff (models/raft_hb.py), or the heartbeat-scheduled mixed
+    sim (models/mixed.scan_fast).  Every returned function is fully traced
+    (no host branches), so it composes with vmap and shard_map.
     """
     _reject_cpp_only(cfg)
     if use_round_schedule(cfg):
         if cfg.protocol == "raft":
             from blockchain_simulator_tpu.models import raft_hb
 
-            fast = raft_hb.make_fast_fn(cfg)
-            tick_cfg = cfg.with_(schedule="tick")
+            # the checked handoff is a lax.cond inside the trace
+            # (models/raft_hb.scan_from_init): the whole program lowers
+            # under jit, vmap (sweeps) and shard_map — no host branch
+            return jax.jit(functools.partial(raft_hb.run, cfg))
+        if cfg.protocol == "mixed":
+            from blockchain_simulator_tpu.models import mixed
 
-            def sim_hb(key):
-                state, ok = fast(key)
-                if not bool(jax.device_get(ok)):
-                    # the election prefix did not reach the quiet handoff
-                    # window (e.g. a split first election re-ran past it):
-                    # the faithful tick engine takes over — the fast path is
-                    # checked, never silently wrong
-                    return make_sim_fn(tick_cfg)(key)
-                return state
+            @jax.jit
+            def sim_mixed(key):
+                state, bufs = mixed.init(cfg, jax.random.fold_in(key, 0x1217))
+                return mixed.scan_fast(cfg, state, bufs, key)
 
-            return sim_hb
+            return sim_mixed
         from blockchain_simulator_tpu.models import pbft_round
 
         @jax.jit

@@ -223,27 +223,41 @@ def child() -> None:
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
-    # ---- stage 0: health probe (tiny matmul; the parent waits for this) ----
-    import jax.numpy as jnp
+    # ---- stage 0: health probe (the parent waits for this) -----------------
+    # utils/health.probe_backend is the shared tiny-matmul probe (it also
+    # backs `python -m blockchain_simulator_tpu.utils.health`); a sick
+    # verdict is printed WITHOUT the "probe" key — the parent's probe wait
+    # and fallback behavior stay exactly as before (a dead child, not a
+    # probed one) — and appended to $BLOCKSIM_HEALTH_JSONL when set.
+    from blockchain_simulator_tpu.utils import health
 
-    t = time.monotonic()
-    backend = jax.default_backend()
-    probe_val = float(jax.jit(lambda a: (a @ a).sum())(
-        jnp.ones((128, 128), jnp.bfloat16)))
+    hrec = health.probe_backend()
+    health.append_health(hrec)
+    if hrec["verdict"] != "healthy":
+        print(json.dumps(hrec), flush=True)
+        print(f"bench-child: backend probe sick: {hrec.get('error')}",
+              file=sys.stderr)
+        sys.exit(1)
+    backend = hrec["backend"]
     print(json.dumps({
         "probe": "ok",
         "backend": backend,
-        "probe_s": round(time.monotonic() - t, 2),
-        "probe_value": probe_val,
+        "probe_s": hrec["probe_s"],
+        "probe_value": hrec["probe_value"],
     }), flush=True)
 
     batch = int(os.environ.get("BENCH_BATCH", "1"))
 
     def emit(value, rounds_done, wall, compile_s, rounds_cfg, cost=None,
-             tag=None):
+             tag=None, cfg=None):
+        # vs_baseline derives from the ROUNDED value so the record is
+        # self-consistent: consumers recomputing round(value/baseline, 4)
+        # from the emitted value must get the emitted vs_baseline (boundary
+        # values like 599.1549 used to disagree in the 4th decimal)
+        value = round(value, 2)
         rec = {
             "metric": METRIC if tag is None else f"{METRIC}__{tag}",
-            "value": round(value, 2),
+            "value": value,
             "unit": "rounds/s",
             "vs_baseline": round(value / BASELINE_ROUNDS_PER_SEC, 4),
             "backend": backend,
@@ -266,6 +280,13 @@ def child() -> None:
                     cost["bytes"] / wall / V5E_HBM_BYTES_S, 4)
         if tag is not None:
             rec["tag"] = tag
+        # the manifest must ride the CHILD's record: the parent deliberately
+        # never imports jax (a sick tunnel makes backend introspection hang,
+        # KNOWN_ISSUES.md #3), so it can only pass child-provided fields on
+        from blockchain_simulator_tpu.utils import obs
+
+        obs.finalize(rec, cfg, compile_s=compile_s, run_s=wall,
+                     rounds=rounds_done)
         print(json.dumps(rec), flush=True)
 
     ladder = [r for r in (ROUNDS_FIRST, ROUNDS) if r > 0]
@@ -292,8 +313,9 @@ def child() -> None:
                     file=sys.stderr,
                 )
                 return
-        value, rounds_done, wall, compile_s, cost = _measure(_cfg(rounds), batch)
-        emit(value, rounds_done, wall, compile_s, rounds, cost=cost)
+        cfg_r = _cfg(rounds)
+        value, rounds_done, wall, compile_s, cost = _measure(cfg_r, batch)
+        emit(value, rounds_done, wall, compile_s, rounds, cost=cost, cfg=cfg_r)
         prev = (value, rounds_done, wall, compile_s)
 
     # ---- companion: serialization-on model (same fast path, shifted wave) --
@@ -307,10 +329,10 @@ def child() -> None:
                 file=sys.stderr,
             )
             return
-        value, rounds_done, wall, compile_s, cost = _measure(
-            _cfg_ser(ROUNDS_SER), batch)
+        cfg_s = _cfg_ser(ROUNDS_SER)
+        value, rounds_done, wall, compile_s, cost = _measure(cfg_s, batch)
         emit(value, rounds_done, wall, compile_s, ROUNDS_SER, cost=cost,
-             tag="serialization_on")
+             tag="serialization_on", cfg=cfg_s)
 
 
 def _parse_child_output(path: str):

@@ -24,6 +24,7 @@ import dataclasses
 import json
 import sys
 
+from blockchain_simulator_tpu.utils import obs
 from blockchain_simulator_tpu.utils.config import FaultConfig, SimConfig
 
 
@@ -131,9 +132,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="include wallclock timing in the output")
     # observability (utils/trace.py; the reference's NS_LOG surface as data)
     p.add_argument("--trace", metavar="FILE.npz",
-                   help="record per-tick probe series (committed blocks, "
-                        "views, elections, ...) to an .npz next to the "
-                        "metrics line")
+                   help="record the probe series (committed blocks, views, "
+                        "elections, ...) to an .npz next to the metrics "
+                        "line — per tick on the general engine, per round/"
+                        "heartbeat on the fast paths (utils/trace.py); "
+                        "with --seeds, one FILE.<seed>.npz per seed")
     p.add_argument("--profile", metavar="LOGDIR",
                    help="capture a jax.profiler trace of the (pre-compiled) "
                         "run into LOGDIR (view with TensorBoard/perfetto)")
@@ -185,6 +188,13 @@ def config_from_args(args) -> SimConfig:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+
+    def emit(record, cfg=None, **kw):
+        """Every result line leaves through here: one JSON line with the
+        obs manifest attached (and, when $BLOCKSIM_RUNS_JSONL is set, the
+        same record appended there — utils/obs.py)."""
+        print(json.dumps(obs.finalize(record, cfg, **kw)))
+
     try:
         cfg = config_from_args(args)
     except ValueError as e:
@@ -245,34 +255,67 @@ def main(argv=None) -> int:
                 return 2
             if args.timing:
                 m["wallclock_s"] = time.perf_counter() - t0
-            print(json.dumps(m))
+            emit(m, cfg)
         return 0
 
     if args.byz_sweep:
         from blockchain_simulator_tpu.parallel.sweep import run_byzantine_sweep
 
         for row in run_byzantine_sweep(cfg, seeds=seeds):
-            print(json.dumps(row))
+            # the row ran cfg with its OWN FaultConfig (sweep.py builds
+            # n_byzantine=f, byz_forge=True per point): hash that config so
+            # the manifest's join key matches what was simulated; the sweep
+            # already appended the row to runs.jsonl (obs.record_run), so
+            # the printed line must not append again
+            row_cfg = cfg.with_(faults=dataclasses.replace(
+                cfg.faults, n_byzantine=row["f"], byz_forge=True))
+            emit(row, row_cfg, append=False)
         return 0
 
     if args.trace or args.profile:
-        if args.shards > 1 or len(seeds) > 1:
-            print("error: --trace/--profile apply to single-seed unsharded "
-                  "jax runs", file=sys.stderr)
+        if args.shards > 1:
+            print("error: --trace/--profile apply to unsharded jax runs",
+                  file=sys.stderr)
             return 2
+        if args.profile and len(seeds) > 1:
+            print("error: --profile applies to single-seed jax runs "
+                  "(--trace accepts --seeds: one FILE.<seed>.npz per seed)",
+                  file=sys.stderr)
+            return 2
+        from blockchain_simulator_tpu.runner import _reject_cpp_only
         from blockchain_simulator_tpu.utils import trace as trace_mod
 
-        if args.trace:
-            import numpy as _np
+        try:
+            # validate BEFORE any compile: cpp-only fidelity flags and
+            # ineligible explicit schedule='round' fail here with the same
+            # message + exit code 2 as every other path (run_traced
+            # re-validates, but a multi-seed loop must not discover the
+            # error on seed 0 after minutes of compile)
+            _reject_cpp_only(cfg)
+            if args.trace:
+                import os as _os
 
-            m, series = trace_mod.run_traced(cfg, seed=seeds[0])
-            _np.savez(args.trace, **series)
-            m["trace_file"] = args.trace
-            m["trace_series"] = sorted(series)
-        else:
-            m = trace_mod.profile_run(cfg, args.profile, seed=seeds[0])
-            m["profile_dir"] = args.profile
-        print(json.dumps(m))
+                import numpy as _np
+
+                for s in seeds:
+                    m, series = trace_mod.run_traced(cfg, seed=s)
+                    if len(seeds) == 1:
+                        path = args.trace
+                    else:
+                        root, ext = _os.path.splitext(args.trace)
+                        path = f"{root}.{s}{ext or '.npz'}"
+                    _np.savez(path, **series)
+                    m["trace_file"] = path
+                    m["trace_series"] = sorted(series)
+                    m["seed"] = s
+                    emit(m, cfg)
+            else:
+                m = trace_mod.profile_run(cfg, args.profile, seed=seeds[0])
+                m["profile_dir"] = args.profile
+                emit(m, cfg)
+        except (ValueError, NotImplementedError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
         return 0
 
     if args.timing and (args.shards > 1 or len(seeds) > 1):
@@ -286,22 +329,30 @@ def main(argv=None) -> int:
 
         mesh = make_mesh(n_node_shards=args.shards)
         if len(seeds) > 1:
+            # append=False: run_seed_sweep already logged each row with
+            # obs.record_run — one runs.jsonl record per run, not two
             for m in run_seed_sweep(cfg, seeds=seeds, mesh=mesh):
-                print(json.dumps(m))
+                emit(m, cfg, append=False)
         else:
-            print(json.dumps(run_sharded(cfg, mesh, seed=seeds[0])))
+            emit(run_sharded(cfg, mesh, seed=seeds[0]), cfg)
         return 0
 
     if len(seeds) > 1:
         from blockchain_simulator_tpu.parallel.sweep import run_seed_sweep
 
         for m in run_seed_sweep(cfg, seeds=seeds):
-            print(json.dumps(m))
+            emit(m, cfg, append=False)
         return 0
 
     from blockchain_simulator_tpu.runner import run_simulation
 
-    print(json.dumps(run_simulation(cfg, seed=seeds[0], with_timing=args.timing)))
+    m = run_simulation(cfg, seed=seeds[0], with_timing=args.timing)
+    emit(
+        m, cfg,
+        compile_s=m.get("compile_plus_first_run_s"),
+        run_s=m.get("wallclock_s"),
+        rounds=m.get("blocks_final_all_nodes", m.get("blocks")),
+    )
     return 0
 
 

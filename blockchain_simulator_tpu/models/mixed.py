@@ -138,32 +138,17 @@ def fast_eligible(cfg) -> bool:
     return raft_hb.eligible(rcfg)
 
 
-def scan_fast(cfg, state: MixedState, bufs: MixedBufs, key):
-    """Heartbeat-scheduled mixed simulation (BASELINE config 5's wall-clock
-    lever): run the full per-tick mixed engine for the raft election prefix,
-    evaluate the checked handoff (models/raft_hb.handoff) in EVERY shard,
-    then ``lax.cond`` on all-shards-quiet:
-
-    - fast branch: the S raft shards collapse to vmapped O(1)-per-heartbeat
-      steady scans (256 shards x 1k nodes stop paying 256k rows of per-tick
-      sampler work), while the S-representative PBFT layer — the only part
-      with genuine per-tick cross-shard dynamics — keeps stepping every tick
-      with its ``alive`` mask pinned all-true (every shard has a live,
-      undeposable leader post-handoff, which is exactly what the per-tick
-      engine would recompute).  PBFT keys/evolution are bit-identical to the
-      per-tick engine; raft milestones follow the raft_hb count contract.
-    - slow branch: any shard failed the handoff (split election, crashed
-      majority) — CONTINUE the per-tick mixed scan from the prefix carry,
-      bit-identical to an uninterrupted tick run.
-
-    Works unsharded, under vmap, and inside shard_map (cfg.mesh_axis row-
-    shards the shard axis; the handoff verdict is psum-agreed)."""
+def prefix_handoff(cfg, state, bufs, key):
+    """Per-tick mixed prefix through the raft election phase, then the
+    checked handoff (models/raft_hb.handoff) in EVERY shard.  Returns
+    ``(carry, ok_all, h_s)`` — shared by ``scan_fast`` (which conds on
+    ``ok_all`` inside the trace) and utils/trace.run_traced (which branches
+    on the host to record the phase that actually ran)."""
     from blockchain_simulator_tpu.models import raft_hb
 
     axis = cfg.mesh_axis
-    rcfg, pcfg = sub_configs(cfg)
+    rcfg, _ = sub_configs(cfg)
     t_e = raft_hb.prefix_ticks(rcfg)
-    s = cfg.mixed_shards
 
     def tick_body(carry, t):
         st, bf = carry
@@ -175,40 +160,103 @@ def scan_fast(cfg, state: MixedState, bufs: MixedBufs, key):
     bad = (~ok_s).sum()
     if axis is not None:
         bad = jax.lax.psum(bad, axis)
-    ok_all = bad == 0
+    return carry, bad == 0, h_s
 
-    def fast_branch(carry):
-        st, bf = carry
-        s_loc = st.raft.block_num.shape[0]
-        base = 0 if axis is None else jax.lax.axis_index(axis) * s_loc
-        # per-shard steady-scan streams key on the GLOBAL shard id, so the
-        # sharded run is bit-identical to the single-device run (the same
-        # convention as step's per-tick shard keys)
-        hb_keys = jax.vmap(
-            lambda i: jax.random.fold_in(key, 0x4BB7 + base + i)
-        )(jnp.arange(s_loc))
+
+def fast_finish(cfg, carry, h_s, key, with_probe: bool = False):
+    """The heartbeat-scheduled steady phase from a quiet handoff: vmapped
+    O(1)-per-heartbeat raft scans + the per-tick S-representative PBFT layer
+    with its ``alive`` mask pinned all-true.  Returns the final MixedState;
+    with ``with_probe`` (utils/trace.run_traced) also per-shard heartbeat
+    series and per-tick global-layer series:
+    ``(state, (raft_ys [S?, K] leaves, pbft_ys [ticks - t_e] leaves))``."""
+    from blockchain_simulator_tpu.models import raft_hb
+
+    axis = cfg.mesh_axis
+    rcfg, pcfg = sub_configs(cfg)
+    t_e = raft_hb.prefix_ticks(rcfg)
+    s = cfg.mixed_shards
+    st, bf = carry
+    s_loc = st.raft.block_num.shape[0]
+    base = 0 if axis is None else jax.lax.axis_index(axis) * s_loc
+    # per-shard steady-scan streams key on the GLOBAL shard id, so the
+    # sharded run is bit-identical to the single-device run (the same
+    # convention as step's per-tick shard keys)
+    hb_keys = jax.vmap(
+        lambda i: jax.random.fold_in(key, 0x4BB7 + base + i)
+    )(jnp.arange(s_loc))
+    if with_probe:
+        res, raft_ys = jax.vmap(
+            lambda k, hh: raft_hb.steady_scan(rcfg, k, hh, with_probe=True)
+        )(hb_keys, h_s)
+    else:
         res = jax.vmap(
             lambda k, hh: raft_hb.steady_scan(rcfg, k, hh)
         )(hb_keys, h_s)
-        raft_final = jax.vmap(
-            lambda rst, hh, r: raft_hb.materialize(rcfg, rst, hh, r)
-        )(st.raft, h_s, res)
-        ones = jnp.ones((s,), bool)
+        raft_ys = None
+    raft_final = jax.vmap(
+        lambda rst, hh, r: raft_hb.materialize(rcfg, rst, hh, r)
+    )(st.raft, h_s, res)
+    ones = jnp.ones((s,), bool)
 
-        def p_body(pcarry, t):
-            ps, pb = pcarry
-            ps = ps.replace(alive=ones)
-            ps, pb = pbft.step(
-                pcfg, ps, pb, t,
-                jax.random.fold_in(prng.tick_key(key, t), 0x9B9B),
-            )
-            return (ps, pb), ()
-
-        (p_state, _), _ = jax.lax.scan(
-            p_body, (st.pbft, bf.pbft),
-            t_e + jnp.arange(max(cfg.ticks - t_e, 0)),
+    def p_body(pcarry, t):
+        ps, pb = pcarry
+        ps = ps.replace(alive=ones)
+        ps, pb = pbft.step(
+            pcfg, ps, pb, t,
+            jax.random.fold_in(prng.tick_key(key, t), 0x9B9B),
         )
-        return MixedState(raft=raft_final, pbft=p_state)
+        ys = (
+            {"global_blocks": ps.block_num.max(),
+             "global_commit_events": ps.slot_commits.sum()}
+            if with_probe
+            else ()
+        )
+        return (ps, pb), ys
+
+    (p_state, _), pbft_ys = jax.lax.scan(
+        p_body, (st.pbft, bf.pbft),
+        t_e + jnp.arange(max(cfg.ticks - t_e, 0)),
+    )
+    final = MixedState(raft=raft_final, pbft=p_state)
+    return (final, (raft_ys, pbft_ys)) if with_probe else final
+
+
+def scan_fast(cfg, state: MixedState, bufs: MixedBufs, key):
+    """Heartbeat-scheduled mixed simulation (BASELINE config 5's wall-clock
+    lever): run the full per-tick mixed engine for the raft election prefix,
+    evaluate the checked handoff (models/raft_hb.handoff) in EVERY shard,
+    then ``lax.cond`` on all-shards-quiet:
+
+    - fast branch (``fast_finish``): the S raft shards collapse to vmapped
+      O(1)-per-heartbeat steady scans (256 shards x 1k nodes stop paying
+      256k rows of per-tick sampler work), while the S-representative PBFT
+      layer — the only part with genuine per-tick cross-shard dynamics —
+      keeps stepping every tick with its ``alive`` mask pinned all-true
+      (every shard has a live, undeposable leader post-handoff, which is
+      exactly what the per-tick engine would recompute).  PBFT keys/
+      evolution are bit-identical to the per-tick engine; raft milestones
+      follow the raft_hb count contract.
+    - slow branch: any shard failed the handoff (split election, crashed
+      majority) — CONTINUE the per-tick mixed scan from the prefix carry,
+      bit-identical to an uninterrupted tick run.
+
+    Works unsharded, under vmap, and inside shard_map (cfg.mesh_axis row-
+    shards the shard axis; the handoff verdict is psum-agreed)."""
+    from blockchain_simulator_tpu.models import raft_hb
+
+    rcfg, _ = sub_configs(cfg)
+    t_e = raft_hb.prefix_ticks(rcfg)
+
+    def tick_body(carry, t):
+        st, bf = carry
+        st, bf = step(cfg, st, bf, t, prng.tick_key(key, t))
+        return (st, bf), ()
+
+    carry, ok_all, h_s = prefix_handoff(cfg, state, bufs, key)
+
+    def fast_branch(carry):
+        return fast_finish(cfg, carry, h_s, key)
 
     def tick_branch(carry):
         (st, _), _ = jax.lax.scan(

@@ -389,23 +389,38 @@ def step_round(cfg, state: PbftRoundState, r, key):
     )
 
 
-def scan_rounds(cfg, state, key):
+def scan_rounds(cfg, state, key, with_probe: bool = False):
     """Scan every block interval inside the simulation window.
 
     Shared by the single-chip runner (runner.make_sim_fn) and the node-
     sharded path (parallel/shard.py), so the truncation semantics — round
     r runs iff its block tick r*interval < cfg.ticks, with the round body
-    masking arrivals past the window — live in exactly one place."""
+    masking arrivals past the window — live in exactly one place.
+
+    ``with_probe=True`` (utils/trace.run_traced) additionally emits the
+    standard pbft probe (utils/trace.probe reads the shared field names)
+    as scan ``ys`` — one sample per ROUND, the state after that round's
+    whole wave — and returns ``(state, ys)``.  The state trajectory is
+    bit-identical either way (the probe only reads)."""
+    from blockchain_simulator_tpu.utils import trace as trace_mod
+
     bt = cfg.pbft_block_interval_ms
     r_last = (cfg.ticks - 1) // bt
     if r_last < 1:
+        if with_probe:
+            empty = jax.tree.map(
+                lambda x: jnp.zeros((0,), x.dtype),
+                trace_mod.probe(cfg, state),
+            )
+            return state, empty
         return state
 
     def body(st, r):
-        return step_round(cfg, st, r, key), ()
+        st = step_round(cfg, st, r, key)
+        return st, trace_mod.probe(cfg, st) if with_probe else ()
 
-    state, _ = jax.lax.scan(body, state, jnp.arange(1, r_last + 1))
-    return state
+    state, ys = jax.lax.scan(body, state, jnp.arange(1, r_last + 1))
+    return (state, ys) if with_probe else state
 
 
 def metrics(cfg, state) -> dict:

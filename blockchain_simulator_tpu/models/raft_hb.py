@@ -94,6 +94,12 @@ def prefix_ticks(cfg) -> int:
     return cfg.raft_election_hi_ms + 2 * rt_hi
 
 
+def n_hb_steps(cfg) -> int:
+    """Static heartbeat-step count of the steady scan (also the length of a
+    traced run's per-heartbeat probe series, utils/trace.run_traced)."""
+    return max((cfg.ticks - prefix_ticks(cfg)) // cfg.raft_heartbeat_ms + 2, 1)
+
+
 def eligible(cfg) -> bool:
     return (
         cfg.protocol == "raft"
@@ -196,15 +202,20 @@ def handoff(cfg, state, axis=None):
     )
 
 
-def steady_scan(cfg, key, h: Handoff):
+def steady_scan(cfg, key, h: Handoff, with_probe: bool = False):
     """Heartbeat-blocked steady-state scan from the handoff scalars.
 
     Pure O(1)-per-step scalar work — no [N] state, no collectives — so it
     vmaps over shards (models/mixed.py) and replicates cheaply under
     shard_map.  Returns ``(hs, open_, bn, rnd, add_on, stopped, bt)``.
+
+    ``with_probe=True`` (utils/trace.run_traced) additionally emits one
+    probe sample per HEARTBEAT step — ``{"blocks", "rounds",
+    "acks_in_window", "stopped"}``, the leader-global values after the
+    step — and returns ``(scan_out, ys)``.  The carry trajectory is
+    bit-identical either way (the probe only reads the carry).
     """
     hb = cfg.raft_heartbeat_ms
-    t_e = prefix_ticks(cfg)
     b_max = cfg.raft_max_blocks
     bins = _ack_bins(cfg)
     b2 = len(bins)
@@ -212,7 +223,7 @@ def steady_scan(cfg, key, h: Handoff):
     # bin processing order within a step: tick-within-step ascending; ties by
     # bin index (same tick => one counter update, order irrelevant)
     order = sorted(range(b2), key=lambda i: bins[i][1])
-    k_steps = max((cfg.ticks - t_e) // hb + 2, 1)
+    k_steps = n_hb_steps(cfg)
     rt_probs = delay_ops.roundtrip_probs(*cfg.one_way_range())
     smode = cfg.eff_stat_sampler
     need = cfg.majority_need
@@ -291,7 +302,13 @@ def steady_scan(cfg, key, h: Handoff):
                                               hs, open_, bn, bt)
         stopped = stopped | (bn >= b_max)  # blockNum>=50 cancels the
         # heartbeat (raft-node.cc:248-251)
-        return (pend, hs, open_, bn, rnd, add_on, stopped, bt), ()
+        ys = (
+            {"blocks": bn, "rounds": rnd, "acks_in_window": hs,
+             "stopped": stopped.astype(jnp.int32)}
+            if with_probe
+            else ()
+        )
+        return (pend, hs, open_, bn, rnd, add_on, stopped, bt), ys
 
     carry0 = (
         jnp.zeros((span, b2), jnp.int32),
@@ -303,10 +320,11 @@ def steady_scan(cfg, key, h: Handoff):
         jnp.bool_(False),                   # stopped
         h.bt0,                              # [B] commit ticks
     )
-    (_, hs, open_, bn, rnd, add_on, stopped, bt), _ = jax.lax.scan(
+    (_, hs, open_, bn, rnd, add_on, stopped, bt), ys = jax.lax.scan(
         hb_body, carry0, jnp.arange(k_steps)
     )
-    return hs, open_, bn, rnd, add_on, stopped, bt
+    out = (hs, open_, bn, rnd, add_on, stopped, bt)
+    return (out, ys) if with_probe else out
 
 
 def materialize(cfg, state, h: Handoff, scan_out, axis=None):

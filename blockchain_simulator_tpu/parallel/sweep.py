@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from blockchain_simulator_tpu.models.base import get_protocol
 from blockchain_simulator_tpu.parallel.mesh import SWEEP_AXIS
 from blockchain_simulator_tpu.runner import make_sim_fn
+from blockchain_simulator_tpu.utils import obs
 from blockchain_simulator_tpu.utils.config import SimConfig
 
 
@@ -47,9 +48,15 @@ def run_seed_sweep(cfg: SimConfig, seeds, mesh=None):
         )
         finals = jax.block_until_ready(batched(keys))
     out = []
-    for i in range(len(seeds)):
+    for i, seed in enumerate(seeds):
         final_i = jax.tree.map(lambda x: x[i], finals)
-        out.append(proto.metrics(cfg, final_i))
+        m = proto.metrics(cfg, final_i)
+        # observability routing: a finalized COPY of every sweep row goes to
+        # the optional runs.jsonl ($BLOCKSIM_RUNS_JSONL, utils/obs.py); the
+        # returned dicts stay pure metrics — tests compare them bit-for-bit
+        # against single runs
+        obs.record_run({"seed": int(seed), **m}, cfg)
+        out.append(m)
     return out
 
 

@@ -10,7 +10,6 @@ batched tensor ops.  Protocol selection is a runtime config field.
 from __future__ import annotations
 
 import functools
-import time
 
 import jax
 import jax.numpy as jnp
@@ -202,22 +201,28 @@ def make_sim_fn(cfg: SimConfig):
 
 def run_simulation(cfg: SimConfig, seed: int | None = None, with_timing: bool = False):
     """Run one simulation; returns the protocol's structured metrics dict
-    (the reference's NS_LOG lines, SURVEY.md §5, as data)."""
+    (the reference's NS_LOG lines, SURVEY.md §5, as data).
+
+    ``with_timing`` stages through ``utils/obs.timed_run`` — the one
+    compile-vs-execution split every timing surface shares — and reports
+    both ``compile_plus_first_run_s`` and the execution-only
+    ``wallclock_s``."""
     proto = get_protocol(cfg.protocol)
     sim = make_sim_fn(cfg)
     key = jax.random.key(cfg.seed if seed is None else seed)
     if with_timing:
-        force_sync(sim(key))  # compile + warm so the timed run is execution only
-    t0 = time.perf_counter()
+        from blockchain_simulator_tpu.utils import obs
+
+        final, compile_s, wall = obs.timed_run(sim, key)
+        m = proto.metrics(cfg, final)
+        m["wallclock_s"] = wall
+        m["compile_plus_first_run_s"] = round(compile_s, 3)
+        m["ticks"] = cfg.ticks
+        return m
     # force_sync, not block_until_ready: the latter returns before execution
     # completes on this env's axon backend (KNOWN_ISSUES.md #1)
     final = force_sync(sim(key))
-    wall = time.perf_counter() - t0
-    m = proto.metrics(cfg, final)
-    if with_timing:
-        m["wallclock_s"] = wall
-        m["ticks"] = cfg.ticks
-    return m
+    return proto.metrics(cfg, final)
 
 
 def final_state(cfg: SimConfig, seed: int | None = None):

@@ -1,0 +1,213 @@
+"""Backend health telemetry: structured tunnel verdicts instead of folklore.
+
+This environment's single-client TPU tunnel is the repo's most fragile
+dependency (KNOWN_ISSUES.md #1, #3): a client hard-killed mid-compile wedges
+it for hours, and a wedged tunnel turns every naive backend init into a
+~25-minute stall.  bench.py has carried an inline defense since round 5 — a
+tiny-matmul probe stage whose absence within a patience window declares the
+tunnel sick.  This module lifts that logic into a reusable, recorded form:
+
+- :func:`probe_backend` — the in-process probe: backend id, device count,
+  tiny-matmul compile+run latency, forced scalar readback.  Returns a
+  structured verdict dict (``healthy`` or ``sick``); never raises.
+- :func:`probe_backend_supervised` — the parent-side classifier: runs the
+  probe in a detached child and, when no verdict lands within ``patience_s``,
+  returns ``wedged`` while ABANDONING the child without killing it (killing a
+  client hung in backend init is what wedges the tunnel, KNOWN_ISSUES.md #3).
+- ``python -m blockchain_simulator_tpu.utils.health`` — prints exactly one
+  JSON verdict line and appends it to a rolling ``HEALTH.jsonl``, so tunnel
+  state across rounds becomes data (`--log ''` disables the file).
+
+bench.py consumes :func:`probe_backend` for its child's stage-0 probe; its
+parent keeps its own patience/abandon loop because it also ladders
+measurements behind the probe.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+VERDICTS = ("healthy", "sick", "wedged")
+
+HEALTH_ENV = "BLOCKSIM_HEALTH_JSONL"
+
+
+def probe_backend(platform: str | None = None) -> dict:
+    """Probe whatever backend jax resolves (or ``platform``) in-process.
+
+    The probe is bench.py's historical stage 0: ``jax.default_backend()``
+    (the init that hangs on a wedged tunnel), then a jitted 128x128 bf16
+    matmul with a forced float readback — the only sync this env honors
+    (KNOWN_ISSUES.md #1).  Healthy cold via the tunnel: ~45 s (~10 s init +
+    ~32 s compile); CPU: well under a second.
+
+    Never raises: any failure returns a ``sick`` verdict with the error
+    string.  A *hang* cannot be classified in-process — callers that need
+    the ``wedged`` verdict use :func:`probe_backend_supervised`.
+    """
+    t0 = time.monotonic()
+    rec: dict = {"verdict": "sick", "probe_s": None, "backend": None}
+    try:
+        import jax
+
+        # the env's sitecustomize forces jax_platforms="axon,cpu" at the
+        # config level, so the env var alone does not stick (conftest.py);
+        # re-assert a caller-requested platform before any backend init
+        platform = platform or os.environ.get("JAX_PLATFORMS") or None
+        if platform:
+            jax.config.update("jax_platforms", platform)
+        import jax.numpy as jnp
+
+        rec["backend"] = jax.default_backend()
+        rec["device_count"] = len(jax.devices())
+        rec["init_s"] = round(time.monotonic() - t0, 2)
+        t1 = time.monotonic()
+        val = float(
+            jax.jit(lambda a: (a @ a).sum())(jnp.ones((128, 128), jnp.bfloat16))
+        )
+        rec["compile_run_s"] = round(time.monotonic() - t1, 2)
+        rec["probe_value"] = val
+        rec["verdict"] = "healthy"
+    except Exception as e:  # a broken backend is the datum, not a crash
+        rec["error"] = f"{type(e).__name__}: {e}"[:500]
+    rec["probe_s"] = round(time.monotonic() - t0, 2)
+    return rec
+
+
+def probe_backend_supervised(patience_s: float = 120.0, env=None) -> dict:
+    """Run the probe in a detached child; classify a silent child as
+    ``wedged``.
+
+    The child is ``python -m blockchain_simulator_tpu.utils.health --child``
+    (one JSON line on stdout).  If no line lands within ``patience_s`` the
+    tunnel is presumed wedged and the child is ABANDONED — left running,
+    never signaled (KNOWN_ISSUES.md #3) — with its pid reported so an
+    operator can watch it free itself.
+    """
+    child_env = dict(os.environ)
+    if env:
+        child_env.update(env)
+    # the child must resolve this package even when the caller runs elsewhere
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    child_env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (pkg_root, child_env.get("PYTHONPATH")) if p
+    )
+    fd, out_path = tempfile.mkstemp(prefix="health_", suffix=".jsonl")
+    out_f = os.fdopen(fd, "w")
+    t0 = time.monotonic()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "blockchain_simulator_tpu.utils.health",
+         "--child"],
+        stdout=out_f,
+        stderr=subprocess.DEVNULL,
+        env=child_env,
+        start_new_session=True,
+    )
+    out_f.close()
+
+    def read_verdict():
+        try:
+            with open(out_path) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if isinstance(rec, dict) and "verdict" in rec:
+                        return rec
+        except OSError:
+            pass
+        return None
+
+    deadline = t0 + patience_s
+    while True:
+        if proc.poll() is not None:
+            rec = read_verdict()
+            if rec is None:
+                rec = {
+                    "verdict": "sick",
+                    "probe_s": round(time.monotonic() - t0, 2),
+                    "backend": None,
+                    "error": f"probe child exited rc={proc.returncode} "
+                             "with no verdict line",
+                }
+            break
+        if time.monotonic() > deadline:
+            rec = {
+                "verdict": "wedged",
+                "probe_s": round(time.monotonic() - t0, 2),
+                "backend": None,
+                "error": f"no probe verdict within {patience_s:.0f}s; child "
+                         "abandoned WITHOUT kill (KNOWN_ISSUES.md #3)",
+                "abandoned_pid": proc.pid,
+            }
+            break
+        time.sleep(0.5)
+    if proc.poll() is not None:
+        try:
+            os.unlink(out_path)
+        except OSError:
+            pass
+    # an abandoned child keeps its output file: it is still writing to it
+    rec["supervised"] = True
+    return rec
+
+
+def append_health(rec: dict, path: str | None = None) -> None:
+    """Append one verdict line to the rolling health log.  Path precedence:
+    explicit arg, $BLOCKSIM_HEALTH_JSONL, nothing (no-op — resolved here so
+    obs.append_jsonl's own $BLOCKSIM_RUNS_JSONL fallback never captures
+    health verdicts).  Failures are swallowed — telemetry never takes down
+    the caller."""
+    from blockchain_simulator_tpu.utils import obs
+
+    path = path or os.environ.get(HEALTH_ENV)
+    if path:
+        obs.append_jsonl(rec, path)
+
+
+def main(argv=None) -> int:
+    """CLI: print exactly ONE JSON verdict line; exit 0 healthy, 1 sick,
+    2 wedged.  Default mode is supervised (the only mode that can report
+    ``wedged`` instead of hanging with the tunnel)."""
+    p = argparse.ArgumentParser(prog="blockchain_simulator_tpu.utils.health")
+    p.add_argument("--child", action="store_true",
+                   help="internal: run the in-process probe and print it")
+    p.add_argument("--in-process", action="store_true",
+                   help="probe this process's backend directly (can hang "
+                        "for ~25 min on a wedged tunnel; default is a "
+                        "supervised child with --patience)")
+    p.add_argument("--patience", type=float, default=120.0,
+                   help="supervised mode: seconds to wait for the child's "
+                        "verdict before declaring the tunnel wedged")
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (e.g. cpu) for the probe")
+    p.add_argument("--log", default="HEALTH.jsonl",
+                   help="rolling verdict log to append to ('' disables)")
+    args = p.parse_args(argv)
+
+    if args.child:
+        rec = probe_backend(platform=args.platform)
+        print(json.dumps(rec), flush=True)
+        return 0 if rec["verdict"] == "healthy" else 1
+
+    if args.in_process:
+        rec = probe_backend(platform=args.platform)
+    else:
+        env = {"JAX_PLATFORMS": args.platform} if args.platform else None
+        rec = probe_backend_supervised(patience_s=args.patience, env=env)
+    rec["ts"] = round(time.time(), 3)
+    print(json.dumps(rec), flush=True)
+    append_health(rec, args.log or None)
+    return {"healthy": 0, "sick": 1}.get(rec["verdict"], 2)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

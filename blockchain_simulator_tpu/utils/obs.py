@@ -1,0 +1,187 @@
+"""Run manifests: one schema-versioned provenance record per JSON output line.
+
+Every entrypoint that prints a result line (cli, bench.py, parallel/sweep.py,
+tools/run_config*.py) routes it through :func:`finalize`, which attaches a
+``manifest`` sub-record — config hash, jax/jaxlib versions, backend + device
+count, the compile-vs-execution wall split, and rounds/s computed uniformly —
+and appends the finalized record to an optional ``runs.jsonl``
+(``BLOCKSIM_RUNS_JSONL``).  ``tools/bench_compare.py`` reads that file (plus
+the committed ``BENCH_*.json``) into a machine-readable perf trajectory.
+
+Design constraints this module must respect:
+
+- **Never initialize a backend.**  The bench parent process deliberately
+  avoids importing jax (a sick TPU tunnel turns backend init into a
+  multi-minute hang, KNOWN_ISSUES.md #3), and the cli's C++-engine path never
+  needs it.  Backend/device fields are therefore filled only when ``jax`` is
+  *already imported* (in which case the caller has initialized the backend
+  itself) or when passed explicitly; package versions come from
+  ``importlib.metadata``, which imports nothing.
+- **Never mutate a caller's metrics dict into inequality.**  Library code
+  (sweeps, runner) returns metrics dicts that tests compare bit-for-bit
+  against other runs; only the *printing* layer attaches manifests.
+  :func:`record_run` exists for libraries: it appends a finalized COPY to
+  ``runs.jsonl`` (when enabled) and leaves the caller's dict untouched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import sys
+import time
+
+OBS_SCHEMA = 1
+
+# Environment switch: when set, every finalized record is appended (one JSON
+# line each) to this path.  Unset = no file I/O (the default for tests).
+RUNS_ENV = "BLOCKSIM_RUNS_JSONL"
+
+
+def _dist_version(name: str) -> str | None:
+    """Installed package version without importing the package."""
+    try:
+        import importlib.metadata
+
+        return importlib.metadata.version(name)
+    except Exception:
+        return None
+
+
+def config_hash(cfg) -> str:
+    """Stable 16-hex-digit digest of a SimConfig (or any dataclass): the
+    join key between a result line, a trace file, and a runs.jsonl record."""
+    if dataclasses.is_dataclass(cfg):
+        d = dataclasses.asdict(cfg)
+    else:
+        d = dict(cfg)
+    blob = json.dumps(d, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def manifest(cfg=None, backend=None, device_count=None) -> dict:
+    """The schema-versioned provenance record.
+
+    ``backend``/``device_count`` are taken from the arguments when given
+    (e.g. bench.py's parent passes the child's probed backend through);
+    otherwise they are read from jax ONLY if jax is already imported — this
+    function never triggers a backend init of its own.
+    """
+    rec: dict = {
+        "obs_schema": OBS_SCHEMA,
+        "ts": round(time.time(), 3),
+        "jax": _dist_version("jax"),
+        "jaxlib": _dist_version("jaxlib"),
+    }
+    if cfg is not None:
+        rec["config_hash"] = config_hash(cfg)
+        rec["protocol"] = getattr(cfg, "protocol", None)
+        rec["n"] = getattr(cfg, "n", None)
+    if backend is None and "jax" in sys.modules:
+        jax = sys.modules["jax"]
+        try:
+            # only read the backend if one is ALREADY initialized: merely
+            # importing the package pulls jax in (e.g. the cli's C++-engine
+            # path), and default_backend() would then trigger a backend init
+            # that can hang for ~25 min on a wedged tunnel (KNOWN_ISSUES #3)
+            from jax._src import xla_bridge
+
+            if getattr(xla_bridge, "_backends", None):
+                backend = jax.default_backend()
+                device_count = len(jax.devices())
+        except Exception:  # backend broken: provenance, never a failure mode
+            pass
+    if backend is not None:
+        rec["backend"] = backend
+    if device_count is not None:
+        rec["device_count"] = device_count
+    return rec
+
+
+def rounds_per_s(rounds, run_s) -> float | None:
+    """THE uniform throughput computation: completed consensus rounds over
+    the measured execution-only wall (never the compile-inclusive first
+    run)."""
+    if rounds is None or not run_s or run_s <= 0:
+        return None
+    return round(rounds / run_s, 2)
+
+
+def timed_run(sim, key, measure_key=None):
+    """Compile-vs-execution wall split via force_sync staging.
+
+    Runs ``sim`` twice through ``utils/sync.force_sync`` (the only sync this
+    env's tunnel honors, KNOWN_ISSUES.md #1): ``sim(key)`` pays compile +
+    warmup, then ``sim(measure_key or key)`` measures execution only (the
+    artifact scripts warm on one seed and report another).  Returns
+    ``(final, compile_plus_first_run_s, run_s)``.
+    """
+    from blockchain_simulator_tpu.utils.sync import force_sync
+
+    t0 = time.perf_counter()
+    force_sync(sim(key))
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    final = force_sync(sim(key if measure_key is None else measure_key))
+    run_s = time.perf_counter() - t0
+    return final, compile_s, run_s
+
+
+def append_jsonl(record: dict, path: str | None = None) -> None:
+    """Append one JSON line; path defaults to $BLOCKSIM_RUNS_JSONL (no-op
+    when neither is set).  Append failures are swallowed: observability must
+    never take down the run it observes."""
+    path = path or os.environ.get(RUNS_ENV)
+    if not path:
+        return
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+    except OSError:
+        pass
+
+
+def finalize(
+    record: dict,
+    cfg=None,
+    compile_s=None,
+    run_s=None,
+    rounds=None,
+    runs_path: str | None = None,
+    append: bool = True,
+) -> dict:
+    """Attach the manifest to ``record`` and (``append=True``) append it to
+    the optional runs.jsonl.  Idempotent: a record that already carries a
+    manifest is returned untouched and NOT re-appended.  Pass
+    ``append=False`` when a library layer (sweep's ``record_run``) already
+    logged the run — the printed line still gets its manifest without the
+    rolling log double-counting it.  Returns ``record`` so call sites stay
+    one-line: ``print(json.dumps(obs.finalize(m, cfg)))``."""
+    if "manifest" in record:
+        return record
+    record["manifest"] = manifest(
+        cfg,
+        backend=record.get("backend"),
+        device_count=record.get("devices"),
+    )
+    if compile_s is not None:
+        record["manifest"]["compile_plus_first_run_s"] = round(compile_s, 3)
+    if run_s is not None:
+        record["manifest"]["run_s"] = round(run_s, 3)
+        rps = rounds_per_s(rounds, run_s)
+        if rps is not None:
+            record["manifest"]["rounds_per_s"] = rps
+    if append:
+        append_jsonl(record, runs_path)
+    return record
+
+
+def record_run(metrics: dict, cfg=None, **kw) -> None:
+    """Library-side hook: append a finalized COPY of ``metrics`` to the
+    optional runs.jsonl without touching the caller's dict (sweep rows are
+    compared bit-for-bit against single runs in tests)."""
+    if not (kw.get("runs_path") or os.environ.get(RUNS_ENV)):
+        return
+    finalize(dict(metrics), cfg, **kw)

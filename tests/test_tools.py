@@ -1,0 +1,203 @@
+"""Observability tooling: run manifests (utils/obs.py), backend health
+verdicts (utils/health.py), and the perf-trajectory tracker
+(tools/bench_compare.py) — plus the one-JSON-line robustness contract on the
+CLI, asserted rather than assumed.
+
+Late-alphabet file on purpose: the subprocess tests (health CLI, committed-
+artifact parsing) run outside the tier-1 window (ROADMAP.md)."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from blockchain_simulator_tpu import SimConfig
+from blockchain_simulator_tpu.utils import obs
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+BENCH_COMPARE = REPO / "tools" / "bench_compare.py"
+
+
+def _run(args, env=None, timeout=120, cwd=REPO):
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    return subprocess.run(
+        [sys.executable] + args, capture_output=True, text=True,
+        timeout=timeout, cwd=cwd, env=full_env,
+    )
+
+
+# ---------------------------------------------------------------- obs ------
+
+def test_config_hash_is_stable_and_config_sensitive():
+    assert obs.config_hash(SimConfig()) == obs.config_hash(SimConfig())
+    assert obs.config_hash(SimConfig()) != obs.config_hash(SimConfig(n=16))
+    assert len(obs.config_hash(SimConfig())) == 16
+
+
+def test_finalize_manifest_and_runs_jsonl(tmp_path, monkeypatch):
+    runs = tmp_path / "runs.jsonl"
+    monkeypatch.setenv(obs.RUNS_ENV, str(runs))
+    cfg = SimConfig(protocol="pbft", n=8)
+    rec = obs.finalize({"value": 1.0, "backend": "cpu"}, cfg,
+                       compile_s=2.0, run_s=0.5, rounds=10)
+    man = rec["manifest"]
+    assert man["obs_schema"] == obs.OBS_SCHEMA
+    assert man["config_hash"] == obs.config_hash(cfg)
+    assert man["backend"] == "cpu"          # record value passes through
+    assert man["jax"]                       # version from importlib.metadata
+    assert man["compile_plus_first_run_s"] == 2.0
+    assert man["rounds_per_s"] == 20.0      # THE uniform computation
+    # idempotent: re-finalizing neither rebuilds the manifest nor re-appends
+    assert obs.finalize(rec, cfg)["manifest"] is man
+    lines = runs.read_text().strip().splitlines()
+    assert len(lines) == 1
+    assert json.loads(lines[0])["manifest"]["config_hash"] == man["config_hash"]
+
+
+def test_record_run_keeps_caller_dict_pure(tmp_path, monkeypatch):
+    runs = tmp_path / "runs.jsonl"
+    monkeypatch.setenv(obs.RUNS_ENV, str(runs))
+    m = {"blocks": 5}
+    obs.record_run(m, SimConfig())
+    assert m == {"blocks": 5}  # sweep rows stay bit-comparable to singles
+    assert "manifest" in json.loads(runs.read_text())
+    # and with the env unset it is a no-op (no surprise files)
+    monkeypatch.delenv(obs.RUNS_ENV)
+    obs.record_run({"blocks": 5}, SimConfig(), runs_path=None)
+
+
+# ------------------------------------------------------- bench_compare -----
+
+def _bench_artifact(tmp_path, n, value, metric="m_rounds_per_sec"):
+    path = tmp_path / f"BENCH_r{n:02d}.json"
+    parsed = None if value is None else {
+        "metric": metric, "value": value, "unit": "rounds/s",
+        "backend": "cpu", "rounds": 100,
+    }
+    path.write_text(json.dumps(
+        {"n": n, "cmd": "python bench.py", "rc": 0 if parsed else 1,
+         "tail": "", "parsed": parsed}))
+    return str(path)
+
+
+def test_bench_compare_parses_every_committed_artifact():
+    committed = sorted(REPO.glob("BENCH_*.json"))
+    assert committed, "committed BENCH artifacts disappeared"
+    proc = _run([str(BENCH_COMPARE)])
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    for p in committed:
+        assert p.name in proc.stdout  # every artifact made the table
+    assert "no regression" in proc.stdout
+
+
+def test_bench_compare_regression_gate(tmp_path):
+    ok = [_bench_artifact(tmp_path, 1, 100.0),
+          _bench_artifact(tmp_path, 2, 95.0)]
+    proc = _run([str(BENCH_COMPARE)] + ok)
+    assert proc.returncode == 0, proc.stdout
+    regressed = ok + [_bench_artifact(tmp_path, 3, 10.0)]
+    proc = _run([str(BENCH_COMPARE)] + regressed)
+    assert proc.returncode == 1
+    assert "REGRESSION" in proc.stdout
+    # a failed round (parsed null) is charted but never compared
+    with_null = ok + [_bench_artifact(tmp_path, 4, None)]
+    proc = _run([str(BENCH_COMPARE)] + with_null)
+    assert proc.returncode == 0, proc.stdout
+
+
+def test_bench_compare_reads_runs_jsonl(tmp_path):
+    runs = tmp_path / "runs.jsonl"
+    rows = [
+        {"metric": "x_rounds_per_sec", "value": 50.0, "backend": "cpu",
+         "manifest": {"obs_schema": 1}},
+        {"metric": "x_rounds_per_sec", "value": 51.0, "backend": "cpu",
+         "manifest": {"obs_schema": 1}},
+    ]
+    runs.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    proc = _run([str(BENCH_COMPARE), _bench_artifact(tmp_path, 1, 100.0),
+                 "--runs", str(runs)])
+    assert proc.returncode == 0, proc.stdout
+    assert "x_rounds_per_sec" in proc.stdout
+
+
+def test_bench_compare_unparseable_artifact_exits_2(tmp_path):
+    bad = tmp_path / "BENCH_r09.json"
+    bad.write_text("{not json")
+    proc = _run([str(BENCH_COMPARE), str(bad)])
+    assert proc.returncode == 2
+    assert "cannot parse" in proc.stderr
+
+
+# --------------------------------------------------------------- health ----
+
+CPU_ENV = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+
+
+def test_health_cli_prints_one_structured_verdict_line(tmp_path):
+    log = tmp_path / "HEALTH.jsonl"
+    proc = _run(["-m", "blockchain_simulator_tpu.utils.health",
+                 "--patience", "240", "--log", str(log)],
+                env=CPU_ENV, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = proc.stdout.strip().splitlines()
+    assert len(lines) == 1  # exactly one JSON verdict line
+    rec = json.loads(lines[0])
+    assert rec["verdict"] == "healthy"
+    assert rec["backend"] == "cpu"
+    assert rec["probe_s"] > 0
+    assert rec["supervised"] is True
+    # the rolling log got the same verdict
+    logged = json.loads(log.read_text().strip().splitlines()[-1])
+    assert logged["verdict"] == "healthy"
+
+
+def test_health_probe_sick_on_bogus_platform():
+    proc = _run(["-m", "blockchain_simulator_tpu.utils.health",
+                 "--in-process", "--platform", "definitely_not_a_backend",
+                 "--log", ""],
+                env={"PALLAS_AXON_POOL_IPS": ""}, timeout=240)
+    assert proc.returncode == 1
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["verdict"] == "sick"
+    assert "error" in rec
+
+
+# ------------------------------------------- CLI one-JSON-line contract ----
+
+@pytest.mark.parametrize("argv", [
+    ["--protocol", "pbft", "--n", "8", "--sim-ms", "600", "--timing"],
+    ["--protocol", "pbft", "--n", "8", "--sim-ms", "600",
+     "--seeds", "0", "1"],
+    ["--protocol", "pbft", "--n", "8", "--sim-ms", "400",
+     "--pbft-rounds", "4", "--pbft-max-slots", "8", "--byz-sweep"],
+])
+def test_cli_every_line_is_json_with_manifest(argv, capsys):
+    from blockchain_simulator_tpu.cli import main
+
+    assert main(argv) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert lines
+    for line in lines:
+        rec = json.loads(line)  # the robustness contract, asserted
+        assert rec["manifest"]["obs_schema"] == obs.OBS_SCHEMA
+        assert rec["manifest"]["config_hash"]
+
+
+def test_cli_timing_reports_compile_split(capsys):
+    from blockchain_simulator_tpu.cli import main
+
+    assert main(["--protocol", "pbft", "--n", "8", "--sim-ms", "500",
+                 "--timing"]) == 0
+    m = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert m["wallclock_s"] > 0
+    assert m["compile_plus_first_run_s"] > 0  # the staged warm run
+    # the manifest mirrors the split and computes rounds/s uniformly
+    assert m["manifest"]["run_s"] == round(m["wallclock_s"], 3)
+    assert m["manifest"].get("rounds_per_s") == obs.rounds_per_s(
+        m["blocks_final_all_nodes"], m["wallclock_s"]
+    )

@@ -1,0 +1,182 @@
+"""Perf-trajectory tracker: the committed ``BENCH_*.json`` round artifacts
+(plus an optional ``runs.jsonl`` from utils/obs.py) become a machine-readable
+per-metric history with a regression gate.
+
+Each ``BENCH_rNN.json`` is the driver's record of one round's ``python
+bench.py`` run: ``{"n": round, "cmd", "rc", "tail", "parsed"}`` where
+``parsed`` is the bench's final JSON line (null when the round produced
+none).  Nothing in the repo read these files until now; this script loads
+them all, prints a per-metric trajectory table, and exits nonzero when the
+newest value regressed beyond ``--threshold`` relative to its predecessor.
+
+The default threshold is deliberately tolerant (50%): the committed history
+mixes backends (a wedged TPU tunnel degrades to the CPU fallback,
+KNOWN_ISSUES.md #3) and machine states, so small swings are environment
+noise — the gate exists to catch order-of-magnitude losses like the r1
+``2.65 rounds/s`` outlier, not 5% jitter.
+
+Usage:
+    python tools/bench_compare.py [BENCH.json ...] [--runs runs.jsonl]
+                                  [--threshold 0.5]
+
+With no positional files, every ``BENCH_*.json`` at the repo root is loaded.
+Exit codes: 0 = no regression, 1 = regression beyond threshold, 2 = an
+artifact failed to parse.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_bench_file(path: str) -> dict:
+    """One BENCH artifact -> one trajectory row (value None for a failed
+    round).  Raises on unparseable JSON — the smoke test's contract."""
+    with open(path) as f:
+        rec = json.load(f)
+    parsed = rec.get("parsed")
+    row = {
+        "source": os.path.basename(path),
+        "round": rec.get("n"),
+        "rc": rec.get("rc"),
+        "metric": None,
+        "value": None,
+        "backend": None,
+    }
+    if isinstance(parsed, dict):
+        row["metric"] = parsed.get("metric")
+        row["value"] = parsed.get("value")
+        row["backend"] = parsed.get("backend")
+        row["rounds"] = parsed.get("rounds")
+        row["wall_s"] = parsed.get("wall_s")
+    return row
+
+
+def load_runs_jsonl(path: str) -> list[dict]:
+    """runs.jsonl records (utils/obs.py finalize) -> trajectory rows.  Rows
+    without a (metric, value) pair fall back to the manifest's uniform
+    rounds/s keyed by config hash, so plain simulation runs chart too."""
+    rows = []
+    try:
+        f = open(path)
+    except OSError:
+        return rows
+    with f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # a torn append must not kill the trajectory
+            if not isinstance(rec, dict):
+                continue
+            man = rec.get("manifest") or {}
+            metric, value = rec.get("metric"), rec.get("value")
+            if metric is None and man.get("rounds_per_s") is not None:
+                metric = (
+                    f"{man.get('protocol', 'run')}_"
+                    f"{man.get('config_hash', 'unknown')}_rounds_per_sec"
+                )
+                value = man["rounds_per_s"]
+            if metric is None:
+                continue
+            rows.append({
+                "source": f"{os.path.basename(path)}:{i + 1}",
+                "round": man.get("ts"),
+                "rc": 0,
+                "metric": metric,
+                "value": value,
+                "backend": rec.get("backend") or man.get("backend"),
+                "rounds": rec.get("rounds"),
+                "wall_s": rec.get("wall_s"),
+            })
+    return rows
+
+
+def trajectory(rows: list[dict]) -> dict[str, list[dict]]:
+    by_metric: dict[str, list[dict]] = {}
+    for row in rows:
+        if row["metric"] is None:
+            by_metric.setdefault("(no result)", []).append(row)
+        else:
+            by_metric.setdefault(row["metric"], []).append(row)
+    return by_metric
+
+
+def check_regressions(by_metric: dict, threshold: float) -> list[str]:
+    """Newest numeric value vs its predecessor, per metric: regressed when
+    ``last < (1 - threshold) * prev``."""
+    failures = []
+    for metric, rows in by_metric.items():
+        vals = [r["value"] for r in rows if isinstance(r["value"], (int, float))]
+        if len(vals) < 2:
+            continue
+        prev, last = vals[-2], vals[-1]
+        if prev > 0 and last < (1.0 - threshold) * prev:
+            failures.append(
+                f"{metric}: {last} vs previous {prev} "
+                f"({last / prev:.1%} of prior; threshold "
+                f"{1 - threshold:.0%})"
+            )
+    return failures
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="bench_compare")
+    p.add_argument("files", nargs="*",
+                   help="BENCH artifacts (default: BENCH_*.json at repo root)")
+    p.add_argument("--runs", default=None,
+                   help="runs.jsonl manifest log to include (utils/obs.py)")
+    p.add_argument("--threshold", type=float, default=0.5,
+                   help="fractional drop vs the previous value that counts "
+                        "as a regression (default 0.5 = halved)")
+    args = p.parse_args(argv)
+
+    files = args.files or sorted(glob.glob(os.path.join(REPO, "BENCH_*.json")))
+    rows = []
+    for path in files:
+        try:
+            rows.append(load_bench_file(path))
+        except (OSError, json.JSONDecodeError, AttributeError) as e:
+            print(f"bench_compare: cannot parse {path}: {e}", file=sys.stderr)
+            return 2
+    rows.sort(key=lambda r: (r["round"] is None, r["round"]))
+    if args.runs:
+        rows.extend(load_runs_jsonl(args.runs))
+
+    by_metric = trajectory(rows)
+    for metric, mrows in sorted(by_metric.items()):
+        print(f"\n{metric}")
+        print(f"  {'source':<24} {'round':>8} {'value':>12} "
+              f"{'backend':>8} {'rounds':>8} {'wall_s':>9}")
+        for r in mrows:
+            print(
+                f"  {r['source']:<24} {str(r['round']):>8} "
+                f"{str(r['value']):>12} {str(r['backend']):>8} "
+                f"{str(r.get('rounds')):>8} {str(r.get('wall_s')):>9}"
+            )
+    failures = check_regressions(by_metric, args.threshold)
+    print()
+    if failures:
+        for msg in failures:
+            print(f"REGRESSION: {msg}")
+        return 1
+    n_vals = sum(
+        1 for rs in by_metric.values()
+        for r in rs if isinstance(r["value"], (int, float))
+    )
+    print(f"ok: {n_vals} measurements across {len(by_metric)} metric(s), "
+          f"no regression beyond {args.threshold:.0%}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,19 +28,14 @@ import jax
 from blockchain_simulator_tpu.engine import run_cpp
 from blockchain_simulator_tpu.models.base import get_protocol
 from blockchain_simulator_tpu.runner import make_sim_fn
+from blockchain_simulator_tpu.utils import obs
 from blockchain_simulator_tpu.utils.config import SimConfig
-from blockchain_simulator_tpu.utils.sync import force_sync
 
 
 def _timed_jax(cfg):
+    """Compile-vs-execution split through the shared obs.timed_run staging."""
     proto = get_protocol(cfg.protocol)
-    sim = make_sim_fn(cfg)
-    t0 = time.perf_counter()
-    force_sync(sim(jax.random.key(cfg.seed)))
-    first = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    final = force_sync(sim(jax.random.key(cfg.seed)))
-    wall = time.perf_counter() - t0
+    final, first, wall = obs.timed_run(make_sim_fn(cfg), jax.random.key(cfg.seed))
     return proto.metrics(cfg, final), wall, first
 
 
@@ -69,15 +64,20 @@ def main() -> None:
     config2 = {
         "cfg": "pbft n=1000, stat delivery, tick engine, single device",
         "backend": jax.default_backend(),
+        "config_hash": obs.config_hash(cfg2),
         "wall_s": round(wall2, 3),
         "compile_plus_first_run_s": round(first2, 3),
-        "rounds_per_s": round(m2["blocks_final_all_nodes"] / wall2, 1)
-        if wall2 > 0 else None,
+        "rounds_per_s": obs.rounds_per_s(m2["blocks_final_all_nodes"], wall2),
         **m2,
     }
+    config1["config_hash"] = obs.config_hash(cfg1)
 
-    out = {"config1": config1, "config2": config2,
-           "backend": jax.default_backend()}
+    out = obs.finalize(
+        {"config1": config1, "config2": config2,
+         "backend": jax.default_backend()},
+        cfg2, compile_s=first2, run_s=wall2,
+        rounds=m2["blocks_final_all_nodes"],
+    )
     path = _os.path.join(_os.path.dirname(_os.path.dirname(
         _os.path.abspath(__file__))), "ARTIFACT_config12.json")
     with open(path, "w") as f:

@@ -24,7 +24,6 @@ import sys as _sys
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
 import json
-import time
 
 import jax
 
@@ -32,17 +31,14 @@ from blockchain_simulator_tpu.models.base import get_protocol
 from blockchain_simulator_tpu.parallel.mesh import make_mesh
 from blockchain_simulator_tpu.parallel.shard import make_sharded_sim_fn
 from blockchain_simulator_tpu.runner import make_sim_fn
+from blockchain_simulator_tpu.utils import obs
 from blockchain_simulator_tpu.utils.config import SimConfig
-from blockchain_simulator_tpu.utils.sync import force_sync
 
 
 def _time_two(sim):
-    t0 = time.perf_counter()
-    force_sync(sim(jax.random.key(0)))
-    first = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    final = force_sync(sim(jax.random.key(1)))
-    wall = time.perf_counter() - t0
+    final, first, wall = obs.timed_run(
+        sim, jax.random.key(0), measure_key=jax.random.key(1)
+    )
     return final, wall, first
 
 
@@ -86,6 +82,7 @@ def main() -> None:
         "compile_plus_first_run_s": round(first, 3),
         **proto.metrics(cfg, final),
     }
+    out = obs.finalize(out, cfg, compile_s=first, run_s=wall)
 
     path = _os.path.join(_os.path.dirname(_os.path.dirname(
         _os.path.abspath(__file__))), "ARTIFACT_config3.json")

@@ -20,14 +20,13 @@ import sys as _sys
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
 import json
-import time
 
 import jax
 
 from blockchain_simulator_tpu.models.base import get_protocol
 from blockchain_simulator_tpu.runner import make_sim_fn
+from blockchain_simulator_tpu.utils import obs
 from blockchain_simulator_tpu.utils.config import FaultConfig, SimConfig
-from blockchain_simulator_tpu.utils.sync import force_sync
 
 
 def main() -> None:
@@ -49,23 +48,22 @@ def main() -> None:
             delivery="stat", model_serialization=False,
             faults=FaultConfig(n_byzantine=f),
         )
-        sim = make_sim_fn(cfg)
-        force_sync(sim(jax.random.key(0)))
-        t0 = time.perf_counter()
-        final = force_sync(sim(jax.random.key(1)))
-        wall = time.perf_counter() - t0
+        final, compile_s, wall = obs.timed_run(
+            make_sim_fn(cfg), jax.random.key(0), measure_key=jax.random.key(1)
+        )
         m = proto.metrics(cfg, final)
         rows.append({
             "f": f,
             "f_frac": round(f / n, 4),
+            "config_hash": obs.config_hash(cfg),
             "wall_s": round(wall, 3),
-            "rounds_per_s": round(m["blocks_final_all_nodes"] / wall, 1)
-            if wall > 0 else None,
+            "compile_plus_first_run_s": round(compile_s, 3),
+            "rounds_per_s": obs.rounds_per_s(m["blocks_final_all_nodes"], wall),
             **{k: m[k] for k in ("rounds_sent", "blocks_final_all_nodes",
                                  "block_num_max", "agreement_ok")},
         })
         print(json.dumps(rows[-1]), flush=True)
-    out = {
+    out = obs.finalize({
         "config": "BASELINE-4 pbft byzantine sweep",
         "backend": jax.default_backend(),
         "n": n,
@@ -73,7 +71,7 @@ def main() -> None:
         "quorum_rule": "n2",
         "schedule": "round fast path",
         "sweep": rows,
-    }
+    })
     path = _os.path.join(_os.path.dirname(_os.path.dirname(
         _os.path.abspath(__file__))), "ARTIFACT_config4.json")
     with open(path, "w") as f_:

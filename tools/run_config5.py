@@ -13,15 +13,14 @@ import sys as _sys
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
 import json
-import time
 
 import jax
 
 from blockchain_simulator_tpu.parallel.mesh import make_mesh
 from blockchain_simulator_tpu.parallel.shard import make_sharded_sim_fn
 from blockchain_simulator_tpu.models.base import get_protocol
+from blockchain_simulator_tpu.utils import obs
 from blockchain_simulator_tpu.utils.config import SimConfig
-from blockchain_simulator_tpu.utils.sync import force_sync
 
 
 def main() -> None:
@@ -36,14 +35,11 @@ def main() -> None:
     mesh = make_mesh(n_node_shards=n_dev)
     proto = get_protocol("mixed")
     sim = make_sharded_sim_fn(cfg, mesh)
-    t0 = time.perf_counter()
-    final = force_sync(sim(jax.random.key(0)))
-    compile_plus_run = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    final = force_sync(sim(jax.random.key(1)))
-    wall = time.perf_counter() - t0
+    final, compile_plus_run, wall = obs.timed_run(
+        sim, jax.random.key(0), measure_key=jax.random.key(1)
+    )
     m = proto.metrics(cfg, final)
-    out = {
+    out = obs.finalize({
         "config": "BASELINE-5 mixed shard sim",
         "backend": jax.default_backend(),
         "devices": n_dev,
@@ -54,7 +50,7 @@ def main() -> None:
         "wall_s": round(wall, 3),
         "compile_plus_first_run_s": round(compile_plus_run, 3),
         **m,
-    }
+    }, cfg, compile_s=compile_plus_run, run_s=wall)
     path = _os.path.join(_os.path.dirname(_os.path.dirname(
         _os.path.abspath(__file__))), "ARTIFACT_config5.json")
     with open(path, "w") as f:

@@ -1,0 +1,8 @@
+"""jaxlint: repo-specific static analysis for the invariants that keep this
+simulator correct and this environment alive (see README "Static analysis"
+and each rule module's docstring for the KNOWN_ISSUES / PR cross-reference).
+
+Run: ``python -m blockchain_simulator_tpu.lint [paths...]``.
+"""
+
+from blockchain_simulator_tpu.lint.common import Finding  # noqa: F401

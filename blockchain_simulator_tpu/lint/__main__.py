@@ -1,0 +1,5 @@
+import sys
+
+from blockchain_simulator_tpu.lint.engine import main
+
+sys.exit(main())

@@ -1,0 +1,261 @@
+"""Shared AST machinery for jaxlint rules.
+
+Everything here is pure ``ast``-level analysis: no file in the analyzed tree
+is ever imported (importing is exactly what some rules exist to police —
+module-scope backend touches must be *found*, not triggered).  Helpers cover
+the three things every rule needs:
+
+- import-alias resolution (``jnp`` -> ``jax.numpy``, ``partial`` ->
+  ``functools.partial``) so rules match canonical dotted names regardless of
+  the import style at the use site;
+- a function index with parent links and qualnames, so findings name the
+  enclosing function and rules can reason about nesting/decorators;
+- the :class:`Finding` record rules emit and the engine filters.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import dataclasses
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str          # repo-relative posix path
+    line: int          # 1-based line of the offending node
+    col: int
+    message: str
+    end_line: int | None = None  # last line the node spans (suppression scan)
+    function: str | None = None  # enclosing function qualname, if any
+
+    def key(self, line_text: str) -> tuple[str, str, str]:
+        """Baseline identity: rule + path + the stripped source line.  Line
+        NUMBERS are deliberately excluded so unrelated edits above a
+        grandfathered finding do not invalidate the baseline."""
+        return (self.rule, self.path, line_text)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        if d["end_line"] is None:
+            d.pop("end_line")
+        if d["function"] is None:
+            d.pop("function")
+        return d
+
+
+BUILTIN_NAMES = frozenset(dir(builtins))
+
+
+def import_aliases(tree: ast.Module) -> dict[str, str]:
+    """Map local names to canonical dotted module/attribute paths.
+
+    ``import jax.numpy as jnp`` -> ``{"jnp": "jax.numpy"}``;
+    ``from jax import lax`` -> ``{"lax": "jax.lax"}``;
+    ``from functools import partial`` -> ``{"partial": "functools.partial"}``.
+    Aliases are collected from the WHOLE tree (function-local imports too):
+    a rule matching ``jax.device_get`` should not be defeated by moving the
+    import inside the offending function.
+    """
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom):
+            if node.level or not node.module:
+                continue  # relative imports cannot be jax/numpy/os/...
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` attribute chain as a string, or None for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def resolve(node: ast.AST, aliases: dict[str, str]) -> str | None:
+    """Canonical dotted path of an expression under the module's import
+    aliases (``jnp.cumsum`` -> ``jax.numpy.cumsum``), or None."""
+    d = dotted(node)
+    if d is None:
+        return None
+    head, _, rest = d.partition(".")
+    head = aliases.get(head, head)
+    return f"{head}.{rest}" if rest else head
+
+
+def decorated_with(fn: ast.AST, names, aliases: dict[str, str]) -> bool:
+    """True when any decorator on ``fn`` resolves into ``names`` — bare
+    (``@jax.jit``), called (``@jax.jit`` with args, ``@lru_cache(8)``), or
+    through ``functools.partial(jax.jit, ...)``.  The single shared matcher
+    for every rule that reasons about decorators."""
+    for dec in getattr(fn, "decorator_list", []):
+        if resolve(dec, aliases) in names:
+            return True
+        if isinstance(dec, ast.Call):
+            rf = resolve(dec.func, aliases)
+            if rf in names:
+                return True
+            if rf == "functools.partial" and dec.args and resolve(
+                dec.args[0], aliases
+            ) in names:
+                return True
+    return False
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    node: ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda
+    parent: "FunctionInfo | None"
+    qualname: str
+
+    @property
+    def name(self) -> str:
+        return getattr(self.node, "name", "<lambda>")
+
+
+class FunctionIndex:
+    """Every function/lambda in a module, with parent links and qualnames."""
+
+    def __init__(self, tree: ast.Module):
+        self.infos: dict[ast.AST, FunctionInfo] = {}
+        self.by_name: dict[str, list[FunctionInfo]] = {}
+        self._walk(tree, None, "")
+
+    def _walk(self, node: ast.AST, parent: FunctionInfo | None, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                name = getattr(child, "name", "<lambda>")
+                qual = f"{prefix}{name}" if not prefix else f"{prefix}.{name}"
+                info = FunctionInfo(child, parent, qual)
+                self.infos[child] = info
+                self.by_name.setdefault(name, []).append(info)
+                self._walk(child, info, qual)
+            elif isinstance(child, ast.ClassDef):
+                self._walk(child, parent, f"{prefix}.{child.name}"
+                           if prefix else child.name)
+            else:
+                self._walk(child, parent, prefix)
+
+def annotate_parents(tree: ast.Module) -> None:
+    """Attach ``._jaxlint_parent`` to every node (one pass; rules that need
+    arbitrary parent lookups use this instead of repeated searches)."""
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._jaxlint_parent = node  # type: ignore[attr-defined]
+
+
+def parent_chain(node: ast.AST):
+    """Iterate parents annotated by :func:`annotate_parents`."""
+    while True:
+        node = getattr(node, "_jaxlint_parent", None)
+        if node is None:
+            return
+        yield node
+
+
+def bound_names(fn: ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda,
+                ) -> set[str]:
+    """Names bound inside a function WITHOUT descending into nested
+    functions: params, assignments, for-targets, with-targets, imports,
+    nested def/class names, comprehension targets."""
+    names: set[str] = set()
+    a = fn.args
+    for arg in (
+        list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+        + ([a.vararg] if a.vararg else []) + ([a.kwarg] if a.kwarg else [])
+    ):
+        names.add(arg.arg)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+
+    def visit(node: ast.AST):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(node.name)
+            return  # do not descend
+        if isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, ast.ClassDef):
+            names.add(node.name)
+            return
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            names.add(node.id)
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for al in node.names:
+                names.add((al.asname or al.name).split(".")[0])
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for stmt in body:
+        visit(stmt)
+    return names
+
+
+def loaded_names(fn: ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda,
+                 ) -> set[str]:
+    """Names read inside a function INCLUDING nested functions (a nested
+    def's free variables are captures of this scope too)."""
+    names: set[str] = set()
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                names.add(node.id)
+    return names
+
+
+def module_level_names(tree: ast.Module) -> set[str]:
+    """Names bound at module scope (defs, classes, imports, assigns)."""
+    names: set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            names.add(stmt.name)
+        elif isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            for al in stmt.names:
+                names.add((al.asname or al.name).split(".")[0])
+        else:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Store
+                ):
+                    names.add(node.id)
+    return names
+
+
+@dataclasses.dataclass
+class RuleContext:
+    """Everything a rule's ``check`` gets: one parsed module + conveniences.
+
+    ``tree`` is parent-annotated (:func:`annotate_parents`) before any rule
+    runs; ``aliases``/``functions`` are computed once per file and shared.
+    """
+
+    path: str                    # repo-relative posix path
+    tree: ast.Module
+    src_lines: list[str]
+    aliases: dict[str, str]
+    functions: FunctionIndex
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.src_lines):
+            return self.src_lines[lineno - 1].strip()
+        return ""

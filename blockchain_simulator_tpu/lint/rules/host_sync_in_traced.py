@@ -1,0 +1,237 @@
+"""host-sync-in-traced: no host round-trips inside traced code.
+
+The PR 1 regression class: raft_hb's original handoff did
+``bool(jax.device_get(ok))`` on the host between two jitted programs, which
+blocked jit/vmap/shard_map composition of the whole fast path (the fix — a
+traced ``lax.cond`` — is what made sharded round-schedule raft and vmapped
+sweeps real).  Any ``jax.device_get`` / ``.item()`` / ``float()`` / ``int()``
+/ ``np.asarray`` reachable from a jit/vmap/pmap-decorated function or a
+scan/cond/while body either breaks tracing outright (ConcretizationTypeError)
+or, worse, silently forces a device sync per call.
+
+Detection is intra-module: traced ROOTS are functions carrying a jit/vmap/
+pmap decorator (including ``functools.partial(jax.jit, ...)`` forms) and
+functions passed as callables to ``jax.jit`` / ``jax.vmap`` / ``jax.pmap`` /
+``jax.lax.{scan,cond,switch,while_loop,fori_loop,map}`` / ``shard_map``
+(directly, as a lambda, or through ``functools.partial``).  Reachability
+propagates through same-module references: a traced function that mentions a
+local function name makes that function traced too (it will be called — or
+``partial``-ed into a scan — during the trace).
+
+Static casts are exempted: ``int(cfg.x)`` on a config read is a Python-level
+constant under trace, and ``int()`` of a literal or ``len()`` is static.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from blockchain_simulator_tpu.lint import common
+
+RULE_ID = "host-sync-in-traced"
+SUMMARY = ("device_get/.item()/float()/int()/np.asarray reachable from "
+           "jit/vmap/scan-body code (PR 1 regression class)")
+
+# decorators / callable-taking transforms that put a function under trace
+JIT_DECORATORS = frozenset({"jax.jit", "jax.vmap", "jax.pmap"})
+TRACING_CALLS = frozenset({
+    "jax.jit", "jax.vmap", "jax.pmap", "jax.checkpoint", "jax.remat",
+    "jax.lax.scan", "jax.lax.cond", "jax.lax.switch", "jax.lax.while_loop",
+    "jax.lax.fori_loop", "jax.lax.map", "jax.lax.associative_scan",
+    "jax.experimental.shard_map.shard_map",
+})
+
+# host-sync callables (canonical dotted names)
+SYNC_CALLS = frozenset({
+    "jax.device_get", "numpy.asarray", "numpy.array", "numpy.frombuffer",
+})
+SYNC_METHODS = frozenset({"item", "tolist"})
+CAST_BUILTINS = frozenset({"float", "int", "bool"})
+
+
+def _is_tracing_callee(callee: ast.AST, aliases: dict[str, str]) -> bool:
+    r = common.resolve(callee, aliases)
+    if r in TRACING_CALLS:
+        return True
+    # local shard_map compat wrappers (parallel/shard.py::_shard_map) keep
+    # their callable-arg position; match by trailing name
+    d = common.dotted(callee)
+    return bool(d) and d.split(".")[-1].lstrip("_") == "shard_map"
+
+
+def _decorated_traced(fn: ast.AST, aliases: dict[str, str]) -> bool:
+    return common.decorated_with(fn, JIT_DECORATORS, aliases)
+
+
+def _callable_args(call: ast.Call, aliases: dict[str, str]):
+    """Yield (name-or-Lambda) callables handed to a tracing transform,
+    looking through ``functools.partial``."""
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        if isinstance(arg, (ast.Name, ast.Lambda)):
+            yield arg
+        elif isinstance(arg, ast.Call) and common.resolve(
+            arg.func, aliases
+        ) == "functools.partial" and arg.args:
+            inner = arg.args[0]
+            if isinstance(inner, (ast.Name, ast.Lambda)):
+                yield inner
+
+
+def _resolve_local(name: str, scope: common.FunctionInfo | None,
+                   idx: common.FunctionIndex) -> list[common.FunctionInfo]:
+    """Lexical resolution of a function name as seen FROM ``scope``: walk
+    the scope chain innermost-out (module scope last) and return the
+    nearest level's definitions.  Prevents an unrelated same-named function
+    in a different scope (this codebase names every scan body ``body``)
+    from being dragged under the trace."""
+    levels: list[common.FunctionInfo | None] = []
+    fi = scope
+    while fi is not None:
+        levels.append(fi)
+        fi = fi.parent
+    levels.append(None)  # module scope
+    for level in levels:
+        hits = [f for f in idx.by_name.get(name, []) if f.parent is level]
+        if hits:
+            return hits
+    return []
+
+
+def _enclosing_info(node: ast.AST, idx: common.FunctionIndex
+                    ) -> common.FunctionInfo | None:
+    for anc in common.parent_chain(node):
+        info = idx.infos.get(anc)
+        if info is not None:
+            return info
+    return None
+
+
+def traced_functions(ctx: common.RuleContext) -> set[ast.AST]:
+    """All function/lambda nodes in the module that run under trace."""
+    idx = ctx.functions
+    traced: set[ast.AST] = set()
+
+    for node, info in idx.infos.items():
+        if _decorated_traced(node, ctx.aliases):
+            traced.add(node)
+
+    for call in ast.walk(ctx.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        if not _is_tracing_callee(call.func, ctx.aliases):
+            continue
+        call_scope = _enclosing_info(call, idx)
+        for target in _callable_args(call, ctx.aliases):
+            if isinstance(target, ast.Lambda):
+                traced.add(target)
+            else:
+                for fi in _resolve_local(target.id, call_scope, idx):
+                    traced.add(fi.node)
+
+    # nested defs inside a traced function are defined during the trace
+    changed = True
+    while changed:
+        changed = False
+        for node, info in idx.infos.items():
+            if node in traced:
+                continue
+            if info.parent is not None and info.parent.node in traced:
+                traced.add(node)
+                changed = True
+        # reachability: a traced function mentioning a local function name
+        # (call, partial, scan arg) pulls that function under the trace —
+        # resolved lexically from the traced function's own scope
+        for node in list(traced):
+            scope = idx.infos.get(node)
+            for sub in _own_nodes(node):
+                if isinstance(sub, ast.Name) and isinstance(
+                    sub.ctx, ast.Load
+                ):
+                    for fi in _resolve_local(sub.id, scope, idx):
+                        if fi.node not in traced:
+                            traced.add(fi.node)
+                            changed = True
+    return traced
+
+
+def _own_nodes(fn: ast.AST):
+    """Walk a function's body WITHOUT descending into nested functions
+    (each traced nested function is analyzed as its own unit)."""
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+STATIC_ATTRS = frozenset({"shape", "ndim", "size", "dtype"})
+
+
+def _static_cast_arg(arg: ast.AST) -> bool:
+    """Casts whose argument is static under trace: literals, ``len()``,
+    shape/ndim/size reads (Python values even on tracers), and
+    config-attribute reads (SimConfig fields are Python scalars baked into
+    the trace — the whole codebase names them ``cfg``/``rcfg``/...)."""
+    if isinstance(arg, ast.Constant):
+        return True
+    if isinstance(arg, ast.Call) and isinstance(arg.func, ast.Name) \
+            and arg.func.id == "len":
+        return True
+    # int(x.shape[0]) / int(x.ndim): static metadata, not a device sync
+    probe = arg
+    if isinstance(probe, ast.Subscript):
+        probe = probe.value
+    if isinstance(probe, ast.Attribute) and probe.attr in STATIC_ATTRS:
+        return True
+    # NOT `self`: a traced flax-struct state method's `int(self.field)` is
+    # a real host sync — only config-named roots are static by convention
+    d = common.dotted(arg)
+    if d:
+        root = d.split(".")[0]
+        if root.endswith("cfg") or root == "config":
+            return True
+    return False
+
+
+def check(ctx: common.RuleContext) -> list[common.Finding]:
+    traced = traced_functions(ctx)
+    findings: list[common.Finding] = []
+    seen: set[tuple[int, int]] = set()
+    for fn in traced:
+        qual = ctx.functions.infos[fn].qualname if fn in ctx.functions.infos \
+            else "<lambda>"
+        for node in _own_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            what = None
+            r = common.resolve(node.func, ctx.aliases)
+            if r in SYNC_CALLS:
+                what = r
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in SYNC_METHODS:
+                what = f".{node.func.attr}()"
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id in CAST_BUILTINS:
+                if node.args and not _static_cast_arg(node.args[0]):
+                    what = f"{node.func.id}()"
+            if what is None or (node.lineno, node.col_offset) in seen:
+                continue
+            seen.add((node.lineno, node.col_offset))
+            findings.append(common.Finding(
+                rule=RULE_ID, path=ctx.path, line=node.lineno,
+                col=node.col_offset,
+                message=(
+                    f"host sync `{what}` reachable from traced function "
+                    f"`{qual}`: host round-trips break jit/vmap/shard_map "
+                    "composition (the PR 1 raft_hb device_get handoff "
+                    "regression class) — keep the branch traced "
+                    "(lax.cond) or move the readback outside the jit"
+                ),
+                end_line=getattr(node, "end_lineno", None),
+                function=qual,
+            ))
+    return findings

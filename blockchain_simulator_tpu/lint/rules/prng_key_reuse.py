@@ -1,0 +1,214 @@
+"""prng-key-reuse: the same PRNG key must not feed two consumers.
+
+BFT simulation results are only trustworthy if the state machine is
+deterministic AND its randomness is independent across use sites (the
+consensus-correctness argument hinges on it — arXiv:1807.04938; measurement
+validity on controlled execution — arXiv:2007.12637).  The repo's PRNG
+discipline (utils/prng.py) is fold-in-per-use: every draw keys off
+``fold_in(key, channel)``.  Passing the SAME key variable directly to two
+``jax.random.*`` consumers silently correlates the two draws — a
+nondeterminism-adjacent bug that no test catches unless the correlation
+happens to shift a pinned metric.
+
+Detection: per function scope, straight-line order with branch-aware merging
+— a name first consumed by ``jax.random.X(name, ...)`` is poisoned until
+reassigned (``key, sub = split(key)`` / ``key = fold_in(key, c)``).  Both
+arms of an ``if`` may consume the same key (exclusive paths); loop bodies
+are processed twice so a key consumed in a loop without reassignment is
+caught (every iteration would see the same key).  ``fold_in``/``split``/
+key constructors are non-consuming.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from blockchain_simulator_tpu.lint import common
+
+RULE_ID = "prng-key-reuse"
+SUMMARY = ("same key passed to two jax.random consumers without an "
+           "intervening split/fold_in (utils/prng.py discipline)")
+
+NON_CONSUMING = frozenset({
+    "fold_in", "split", "key", "PRNGKey", "key_data", "wrap_key_data",
+    "key_impl", "clone",
+})
+
+State = dict  # name -> (consumer, lineno)
+
+
+def _terminates(stmts: list[ast.stmt]) -> bool:
+    """Does this (possibly empty) block unconditionally leave the scope?"""
+    if not stmts:
+        return False
+    return isinstance(stmts[-1], (ast.Return, ast.Raise, ast.Continue,
+                                  ast.Break))
+
+
+def _consumer(call: ast.Call, aliases: dict[str, str]) -> str | None:
+    r = common.resolve(call.func, aliases)
+    if not r or not r.startswith("jax.random."):
+        return None
+    tail = r.rsplit(".", 1)[-1]
+    return None if tail in NON_CONSUMING else tail
+
+
+class _Scope:
+    def __init__(self, ctx: common.RuleContext, qual: str):
+        self.ctx = ctx
+        self.qual = qual
+        self.findings: list[common.Finding] = []
+        self.seen: set[tuple[int, int]] = set()
+
+    # ---- expressions --------------------------------------------------
+    def do_expr(self, node: ast.AST, state: State) -> None:
+        if node is None or isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                   ast.ClassDef)
+        ):
+            return  # separate scope
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            # comprehensions are loops: process the element twice so a key
+            # consumed per iteration without rebinding is caught, clearing
+            # per-iteration targets before each pass
+            for gen in node.generators:
+                self.do_expr(gen.iter, state)
+            body = [node.key, node.value] if isinstance(node, ast.DictComp) \
+                else [node.elt]
+            for _ in range(2):
+                for gen in node.generators:
+                    self._clear_targets(gen.target, state)
+                    for cond in gen.ifs:
+                        self.do_expr(cond, state)
+                for b in body:
+                    self.do_expr(b, state)
+            return
+        if isinstance(node, ast.IfExp):
+            # ternary arms are exclusive paths, same as ast.If
+            self.do_expr(node.test, state)
+            s_body, s_else = dict(state), dict(state)
+            self.do_expr(node.body, s_body)
+            self.do_expr(node.orelse, s_else)
+            state.update(s_body)
+            state.update(s_else)
+            return
+        if isinstance(node, ast.Call):
+            for child in ast.iter_child_nodes(node):
+                self.do_expr(child, state)
+            name = _consumer(node, self.ctx.aliases)
+            if name and node.args and isinstance(node.args[0], ast.Name):
+                key = node.args[0].id
+                if key in state:
+                    prev_name, prev_line = state[key]
+                    loc = (node.lineno, node.col_offset)
+                    if loc not in self.seen:
+                        self.seen.add(loc)
+                        self.findings.append(common.Finding(
+                            rule=RULE_ID, path=self.ctx.path,
+                            line=node.lineno, col=node.col_offset,
+                            message=(
+                                f"PRNG key `{key}` consumed by jax.random."
+                                f"{name} was already consumed by jax.random."
+                                f"{prev_name} (line {prev_line}) with no "
+                                "intervening split/fold_in: the two draws "
+                                "are identical bit streams (utils/prng.py "
+                                "fold-in-per-use discipline)"
+                            ),
+                            end_line=getattr(node, "end_lineno", None),
+                            function=self.qual,
+                        ))
+                else:
+                    state[key] = (name, node.lineno)
+            return
+        for child in ast.iter_child_nodes(node):
+            self.do_expr(child, state)
+
+    # ---- statements ---------------------------------------------------
+    def _clear_targets(self, target: ast.AST, state: State) -> None:
+        for node in ast.walk(target):
+            if isinstance(node, ast.Name):
+                state.pop(node.id, None)
+
+    def do_stmts(self, stmts: list[ast.stmt], state: State) -> State:
+        for stmt in stmts:
+            state = self.do_stmt(stmt, state)
+        return state
+
+    def do_stmt(self, stmt: ast.stmt, state: State) -> State:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return state  # nested scopes analyzed separately
+        if isinstance(stmt, ast.Assign):
+            self.do_expr(stmt.value, state)
+            for t in stmt.targets:
+                self._clear_targets(t, state)
+            return state
+        if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            if stmt.value is not None:
+                self.do_expr(stmt.value, state)
+            self._clear_targets(stmt.target, state)
+            return state
+        if isinstance(stmt, ast.If):
+            self.do_expr(stmt.test, state)
+            s_body = self.do_stmts(stmt.body, dict(state))
+            s_else = self.do_stmts(stmt.orelse, dict(state))
+            # a terminating arm (guard clause: return/raise/...) never
+            # reaches the code after the if — only fall-through arms merge
+            body_falls = not _terminates(stmt.body)
+            else_falls = not _terminates(stmt.orelse)
+            merged: State = {}
+            if body_falls:
+                merged.update(s_body)
+            if else_falls:
+                merged.update(s_else)
+            if not (body_falls or else_falls):
+                merged = dict(state)  # code after the if is unreachable
+            return merged
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.do_expr(stmt.iter, state)
+            # two passes: a key consumed in the body without reassignment
+            # sees the SAME bits every iteration — the second pass flags it
+            self._clear_targets(stmt.target, state)
+            state = self.do_stmts(stmt.body, state)
+            self._clear_targets(stmt.target, state)
+            state = self.do_stmts(stmt.body, state)
+            return self.do_stmts(stmt.orelse, state)
+        if isinstance(stmt, ast.While):
+            self.do_expr(stmt.test, state)
+            state = self.do_stmts(stmt.body, state)
+            state = self.do_stmts(stmt.body, state)
+            return self.do_stmts(stmt.orelse, state)
+        if isinstance(stmt, ast.Try):
+            s = self.do_stmts(stmt.body, dict(state))
+            for h in stmt.handlers:
+                s.update(self.do_stmts(h.body, dict(state)))
+            s = self.do_stmts(stmt.orelse, s)
+            return self.do_stmts(stmt.finalbody, s)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.do_expr(item.context_expr, state)
+                if item.optional_vars is not None:
+                    self._clear_targets(item.optional_vars, state)
+            return self.do_stmts(stmt.body, state)
+        if isinstance(stmt, (ast.Return, ast.Expr)):
+            if stmt.value is not None:
+                self.do_expr(stmt.value, state)
+            return state
+        # default: process any embedded expressions conservatively
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self.do_expr(child, state)
+        return state
+
+
+def check(ctx: common.RuleContext) -> list[common.Finding]:
+    findings: list[common.Finding] = []
+    for node, info in ctx.functions.infos.items():
+        scope = _Scope(ctx, info.qualname)
+        if isinstance(node, ast.Lambda):
+            scope.do_expr(node.body, {})  # lambdas consume keys too
+        else:
+            scope.do_stmts(node.body, {})
+        findings.extend(scope.findings)
+    return findings

@@ -1,0 +1,70 @@
+"""probe-child-kill: bench/health code must abandon children, not kill them.
+
+KNOWN_ISSUES #3: a TPU client hard-killed mid-compile wedged the tunnel for
+HOURS (observed rounds 3 and 4 — the round-4 wedge was never recovered), and
+every later backend init stalls ~25 minutes.  The repo's defense is the
+abandon-don't-kill rule: a probe/bench child that has not produced output is
+presumed hung in backend init and must be LEFT RUNNING (utils/health.py's
+supervised mode, bench.py's probe-patience path).  Signaling a subprocess —
+``os.kill``/``os.killpg``, ``proc.terminate()``, ``proc.kill()``,
+``proc.send_signal()`` — in bench/health/tools code is therefore a reviewed
+exception, never a default: the only sanctioned use is bench.py's last-
+resort escalation of a child that ALREADY probed healthy and then overran
+(by then it is hung in device work, not tunnel init).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from blockchain_simulator_tpu.lint import common
+
+RULE_ID = "probe-child-kill"
+SUMMARY = ("os.kill/.terminate()/.send_signal() on subprocess handles in "
+           "bench/health/tools code (abandon-don't-kill, KNOWN_ISSUES #3)")
+
+OS_KILLS = frozenset({
+    "os.kill", "os.killpg", "signal.pthread_kill",
+})
+KILL_METHODS = frozenset({"terminate", "kill", "send_signal"})
+
+
+def in_scope(path: str) -> bool:
+    return (
+        path.rsplit("/", 1)[-1] == "bench.py"
+        or path.startswith("tools/") or "/tools/" in path
+        or path.endswith("utils/health.py")
+    )
+
+
+def check(ctx: common.RuleContext) -> list[common.Finding]:
+    if not in_scope(ctx.path):
+        return []
+    findings: list[common.Finding] = []
+    for call in ast.walk(ctx.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        r = common.resolve(call.func, ctx.aliases)
+        if r in OS_KILLS:
+            what = r
+        elif (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr in KILL_METHODS
+            and (r is None or not r.startswith(("os.", "signal.")))
+        ):
+            what = f".{call.func.attr}()"
+        else:
+            continue
+        findings.append(common.Finding(
+            rule=RULE_ID, path=ctx.path, line=call.lineno,
+            col=call.col_offset,
+            message=(
+                f"`{what}` signals a child process in bench/health code: "
+                "killing a client hung in backend init is what wedges the "
+                "single-client TPU tunnel for hours (KNOWN_ISSUES #3) — "
+                "abandon the child (utils/health.py supervised mode) or "
+                "justify a post-probe last-resort escalation inline"
+            ),
+            end_line=getattr(call, "end_lineno", None),
+        ))
+    return findings

@@ -1,0 +1,138 @@
+"""static-arg-recompile-hazard: per-call jit wrappers over closure captures.
+
+``jax.jit`` caches compiled programs PER WRAPPER OBJECT.  A jit created
+inside a plain function — a nested ``@jax.jit def`` or a ``jax.jit(...)``
+call — that closes over the enclosing function's parameters or locals builds
+a FRESH wrapper (and therefore a fresh XLA compile) on every call of the
+enclosing function; the captured Python scalars are baked into each trace,
+so nothing is ever reused.  On this repo's configs a single wasted recompile
+is minutes of XLA:CPU time (the 100k-node program alone is ~7 min,
+bench.py's fallback notes), which is why every real factory in the tree
+(``runner.make_sim_fn``, ``utils/trace.py``'s traced fns,
+``parallel/shard.py``'s sharded builders) is ``functools.lru_cache``-d on a
+hashable SimConfig.
+
+The rule flags jit application inside a function whose enclosing chain has
+no ``lru_cache``/``cache`` decorator when the jitted callable (or the jit
+call's argument expression) captures names bound in the enclosing scopes.
+A jit over a no-capture lambda (utils/health.py's probe matmul) is clean:
+there is nothing cacheable to lose.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from blockchain_simulator_tpu.lint import common
+
+RULE_ID = "static-arg-recompile-hazard"
+SUMMARY = ("jit built per call over enclosing-scope captures without an "
+           "lru_cache factory: every call recompiles "
+           "(runner.make_sim_fn is the sanctioned pattern)")
+
+JIT_NAMES = frozenset({"jax.jit", "jax.pmap"})
+CACHED_DECOS = frozenset({"functools.lru_cache", "functools.cache"})
+
+
+def _is_cached(fn: ast.AST, aliases: dict[str, str]) -> bool:
+    return common.decorated_with(fn, CACHED_DECOS, aliases)
+
+
+def _jit_decorator(fn: ast.AST, aliases: dict[str, str]) -> bool:
+    return common.decorated_with(fn, JIT_NAMES, aliases)
+
+
+def _ancestor_bound(info: common.FunctionInfo | None) -> set[str]:
+    names: set[str] = set()
+    while info is not None:
+        names |= common.bound_names(info.node)
+        info = info.parent
+    return names
+
+
+def _chain_cached(info: common.FunctionInfo | None,
+                  aliases: dict[str, str]) -> bool:
+    while info is not None:
+        if _is_cached(info.node, aliases):
+            return True
+        info = info.parent
+    return False
+
+
+def check(ctx: common.RuleContext) -> list[common.Finding]:
+    findings: list[common.Finding] = []
+    mod_names = common.module_level_names(ctx.tree)
+
+    def add(node: ast.AST, captures: set[str], encl: str) -> None:
+        shown = ", ".join(sorted(captures))
+        findings.append(common.Finding(
+            rule=RULE_ID, path=ctx.path, line=node.lineno,
+            col=node.col_offset,
+            message=(
+                f"jit built inside `{encl}` captures per-call values "
+                f"({{{shown}}}): each call creates a fresh wrapper and "
+                "recompiles from scratch — hoist into an "
+                "functools.lru_cache factory keyed on the hashable config "
+                "(runner.make_sim_fn pattern) or pass the values as traced "
+                "arguments"
+            ),
+            end_line=getattr(node, "end_lineno", None),
+            function=encl,
+        ))
+
+    # (a) nested `@jax.jit def` under an uncached enclosing function
+    for node, info in ctx.functions.infos.items():
+        if isinstance(node, ast.Lambda) or info.parent is None:
+            continue
+        if not _jit_decorator(node, ctx.aliases):
+            continue
+        if _chain_cached(info.parent, ctx.aliases):
+            continue
+        captures = (
+            common.loaded_names(node) - common.bound_names(node)
+            - mod_names - common.BUILTIN_NAMES - set(ctx.aliases)
+        ) & _ancestor_bound(info.parent)
+        if captures:
+            add(node, captures, info.parent.qualname)
+
+    # (b) `jax.jit(...)` called inside an uncached function body
+    for call in ast.walk(ctx.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        if common.resolve(call.func, ctx.aliases) not in JIT_NAMES:
+            continue
+        parent = getattr(call, "_jaxlint_parent", None)
+        if parent is not None and call in getattr(
+            parent, "decorator_list", ()
+        ):
+            continue  # decorator form: handled by (a)
+        encl_node = None
+        for anc in common.parent_chain(call):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                encl_node = anc
+                break
+        if encl_node is None:
+            continue  # module-scope jit over module-level fn: one wrapper
+        info = ctx.functions.infos.get(encl_node)
+        if info is None or _chain_cached(info, ctx.aliases):
+            continue
+        names: set[str] = set()
+        lambda_bound: set[str] = set()
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Name) and isinstance(
+                    sub.ctx, ast.Load
+                ):
+                    names.add(sub.id)
+                elif isinstance(sub, ast.Lambda):
+                    lambda_bound |= common.bound_names(sub)
+        # import-bound names (module aliases, function-local `import jax`)
+        # are process-stable, not per-call values
+        captures = (
+            (names - lambda_bound - mod_names - common.BUILTIN_NAMES
+             - set(ctx.aliases))
+            & _ancestor_bound(info)
+        )
+        if captures:
+            add(call, captures, info.qualname)
+    return findings

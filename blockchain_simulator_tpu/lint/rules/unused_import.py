@@ -1,0 +1,116 @@
+"""unused-import: dead imports are noise and, for jax, latency.
+
+Mostly hygiene (PR 1 already dropped one stray numpy import from a model),
+but with one repo-specific edge: an unused ``import jax`` is ~2 s of wasted
+interpreter start on this box and — through the sitecustomize PJRT plugin
+registration — one more module whose import order can interact with backend
+selection (tests/conftest.py's two-layer forcing exists for exactly that).
+
+``__init__.py`` files are exempt wholesale (re-export surfaces), and any
+line carrying a ``noqa`` comment is honored in addition to the standard
+``# jaxlint: disable=`` mechanism.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from blockchain_simulator_tpu.lint import common
+
+RULE_ID = "unused-import"
+SUMMARY = "imports never referenced in the module (F401-class hygiene)"
+
+
+def _quoted_annotation_names(tree: ast.Module) -> set[str]:
+    """Names referenced inside STRING annotations (``x: "List[int]"`` —
+    forward references evaluate lazily but still use the import)."""
+    anns: list[ast.AST | None] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            for arg in (
+                list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+                + ([a.vararg] if a.vararg else [])
+                + ([a.kwarg] if a.kwarg else [])
+            ):
+                anns.append(arg.annotation)
+            anns.append(node.returns)
+        elif isinstance(node, ast.AnnAssign):
+            anns.append(node.annotation)
+    names: set[str] = set()
+    for ann in anns:
+        if ann is None:
+            continue
+        for sub in ast.walk(ann):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                try:
+                    expr = ast.parse(sub.value, mode="eval")
+                except SyntaxError:
+                    continue
+                for n in ast.walk(expr):
+                    if isinstance(n, ast.Name):
+                        names.add(n.id)
+    return names
+
+
+def check(ctx: common.RuleContext) -> list[common.Finding]:
+    if ctx.path.endswith("__init__.py"):
+        return []
+    # (name, shown, line, col, end_line) — end_line makes the engine's
+    # span-based suppression (and the noqa check below) cover continuation
+    # lines of parenthesized multiline imports
+    bindings: list[tuple[str, str, int, int, int]] = []
+    for node in ast.walk(ctx.tree):
+        end = getattr(node, "end_lineno", None) or node.lineno \
+            if isinstance(node, (ast.Import, ast.ImportFrom)) else 0
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = (a.asname or a.name).split(".")[0]
+                bindings.append((name, a.name if not a.asname
+                                 else f"{a.name} as {a.asname}",
+                                 node.lineno, node.col_offset, end))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                name = a.asname or a.name
+                shown = f"from {'.' * node.level}{node.module or ''} " \
+                        f"import {a.name}" + (
+                            f" as {a.asname}" if a.asname else "")
+                bindings.append((name, shown, node.lineno,
+                                 node.col_offset, end))
+
+    used: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            d = common.dotted(node)
+            if d:
+                used.add(d.split(".")[0])
+    used |= _quoted_annotation_names(ctx.tree)
+    # names exported via __all__ count as used
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "__all__"
+            for t in node.targets
+        ):
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Constant) and isinstance(
+                    sub.value, str
+                ):
+                    used.add(sub.value)
+
+    findings = []
+    for name, shown, line, col, end in bindings:
+        if name in used:
+            continue
+        if any("noqa" in ctx.line_text(ln) for ln in range(line, end + 1)):
+            continue
+        findings.append(common.Finding(
+            rule=RULE_ID, path=ctx.path, line=line, col=col,
+            message=f"unused import `{shown}`", end_line=end,
+        ))
+    return findings

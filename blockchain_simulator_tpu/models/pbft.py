@@ -63,7 +63,10 @@ from blockchain_simulator_tpu.ops import topology
 from blockchain_simulator_tpu.ops.ring import ring_pop, ring_push_add, ring_push_max
 from blockchain_simulator_tpu.utils.prng import Channel, chan_key
 
-_NEVER = jnp.iinfo(jnp.int32).max  # propose-tick sentinel (min-reduced)
+# propose-tick sentinel (min-reduced); np, not jnp: same int either way
+# (iinfo is pure dtype metadata), and module scope stays trivially free of
+# jax calls (jaxlint module-scope-backend-touch)
+_NEVER = np.iinfo(np.int32).max
 
 # state fields that are per-slot accumulators, NOT node-sharded: every shard
 # holds a partial that ``finalize`` combines (parallel/shard.py keeps them

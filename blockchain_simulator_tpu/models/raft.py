@@ -71,8 +71,11 @@ from blockchain_simulator_tpu.ops.ring import ring_pop, ring_push_add, ring_push
 from blockchain_simulator_tpu.utils.prng import Channel, chan_key
 
 # Timer sentinel: "canceled" (Simulator::Cancel).  Any tick comparison against
-# it is false for the whole simulation horizon.
-DISARM = jnp.int32(1 << 30)
+# it is false for the whole simulation horizon.  np, not jnp: a jnp scalar
+# here would create a device array AT IMPORT TIME — a backend init that can
+# stall ~25 min on a wedged tunnel (jaxlint module-scope-backend-touch,
+# KNOWN_ISSUES #3/#4); the np.int32 promotes identically inside traces.
+DISARM = np.int32(1 << 30)
 
 
 @struct.dataclass

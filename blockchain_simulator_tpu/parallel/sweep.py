@@ -10,6 +10,8 @@ f value but batches all seeds of that f.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -18,6 +20,21 @@ from blockchain_simulator_tpu.parallel.mesh import SWEEP_AXIS
 from blockchain_simulator_tpu.runner import make_sim_fn
 from blockchain_simulator_tpu.utils import obs
 from blockchain_simulator_tpu.utils.config import SimConfig
+
+
+@functools.lru_cache(maxsize=32)
+def _batched_fn(cfg: SimConfig, mesh=None):
+    """Jitted ``batched(keys) -> finals`` for one (cfg, mesh): cached so
+    repeated sweeps of one config reuse the compiled program instead of
+    building a fresh jit wrapper per call (jaxlint
+    static-arg-recompile-hazard; runner.make_sim_fn convention)."""
+    if mesh is None:
+        return jax.jit(jax.vmap(make_sim_fn(cfg)))
+    from blockchain_simulator_tpu.parallel.shard import make_sharded_sim_fn
+
+    return jax.jit(
+        jax.vmap(make_sharded_sim_fn(cfg, mesh), spmd_axis_name=SWEEP_AXIS)
+    )
 
 
 def run_seed_sweep(cfg: SimConfig, seeds, mesh=None):
@@ -37,16 +54,7 @@ def run_seed_sweep(cfg: SimConfig, seeds, mesh=None):
                 f"{len(seeds)} seeds not divisible by sweep axis size {n_sweep}"
             )
     keys = jax.vmap(jax.random.key)(jnp.asarray(seeds, jnp.uint32))
-    if mesh is None:
-        batched = jax.jit(jax.vmap(make_sim_fn(cfg)))
-        finals = jax.block_until_ready(batched(keys))
-    else:
-        from blockchain_simulator_tpu.parallel.shard import make_sharded_sim_fn
-
-        batched = jax.jit(
-            jax.vmap(make_sharded_sim_fn(cfg, mesh), spmd_axis_name=SWEEP_AXIS)
-        )
-        finals = jax.block_until_ready(batched(keys))
+    finals = jax.block_until_ready(_batched_fn(cfg, mesh)(keys))
     out = []
     for i, seed in enumerate(seeds):
         final_i = jax.tree.map(lambda x: x[i], finals)

@@ -64,12 +64,14 @@ def probe_backend(platform: str | None = None) -> dict:
             jax.config.update("jax_platforms", platform)
         import jax.numpy as jnp
 
-        rec["backend"] = jax.default_backend()
-        rec["device_count"] = len(jax.devices())
+        # the probe's JOB is the backend init (the one call that hangs on a
+        # wedged tunnel); callers run it in a supervised, abandonable child
+        rec["backend"] = jax.default_backend()  # jaxlint: disable=module-scope-backend-touch
+        rec["device_count"] = len(jax.devices())  # jaxlint: disable=module-scope-backend-touch
         rec["init_s"] = round(time.monotonic() - t0, 2)
         t1 = time.monotonic()
         val = float(
-            jax.jit(lambda a: (a @ a).sum())(jnp.ones((128, 128), jnp.bfloat16))
+            jax.jit(lambda a: (a @ a).sum())(jnp.ones((128, 128), jnp.bfloat16))  # jaxlint: disable=module-scope-backend-touch
         )
         rec["compile_run_s"] = round(time.monotonic() - t1, 2)
         rec["probe_value"] = val

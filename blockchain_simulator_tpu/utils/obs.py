@@ -89,8 +89,10 @@ def manifest(cfg=None, backend=None, device_count=None) -> dict:
             from jax._src import xla_bridge
 
             if getattr(xla_bridge, "_backends", None):
-                backend = jax.default_backend()
-                device_count = len(jax.devices())
+                # guarded: only reached when a backend ALREADY exists, so
+                # neither call below can trigger an init of its own
+                backend = jax.default_backend()  # jaxlint: disable=module-scope-backend-touch
+                device_count = len(jax.devices())  # jaxlint: disable=module-scope-backend-touch
         except Exception:  # backend broken: provenance, never a failure mode
             pass
     if backend is not None:

@@ -71,6 +71,30 @@ def test_record_run_keeps_caller_dict_pure(tmp_path, monkeypatch):
     obs.record_run({"blocks": 5}, SimConfig(), runs_path=None)
 
 
+def test_manifest_never_triggers_backend_init(monkeypatch):
+    """Regression pin for the PR 2 guard (now also enforced statically by
+    jaxlint's module-scope-backend-touch rule): with NO backend initialized
+    (xla_bridge._backends empty — the wedged-tunnel situation where
+    default_backend() would stall ~25 min, KNOWN_ISSUES #3), building a
+    manifest must neither call backend introspection nor fail."""
+    import jax
+    from jax._src import xla_bridge
+
+    def boom(*a, **kw):  # any introspection call = the bug
+        raise AssertionError("manifest triggered a backend init")
+
+    monkeypatch.setattr(xla_bridge, "_backends", {})
+    monkeypatch.setattr(jax, "default_backend", boom)
+    monkeypatch.setattr(jax, "devices", boom)
+    rec = obs.manifest(SimConfig(protocol="pbft", n=8))
+    assert rec["obs_schema"] == obs.OBS_SCHEMA
+    assert rec["config_hash"]
+    assert "backend" not in rec and "device_count" not in rec
+    # explicit caller-provided values still pass through untouched
+    rec = obs.manifest(None, backend="tpu", device_count=4)
+    assert rec["backend"] == "tpu" and rec["device_count"] == 4
+
+
 # ------------------------------------------------------- bench_compare -----
 
 def _bench_artifact(tmp_path, n, value, metric="m_rounds_per_sec"):
@@ -125,12 +149,48 @@ def test_bench_compare_reads_runs_jsonl(tmp_path):
     assert "x_rounds_per_sec" in proc.stdout
 
 
+def test_bench_compare_never_gates_findings_counters(tmp_path):
+    """jaxlint_new_findings is lower-is-better: a drop (findings FIXED) must
+    chart but never trip the throughput regression gate."""
+    runs = tmp_path / "runs.jsonl"
+    rows = [
+        {"metric": "jaxlint_new_findings", "value": 1,
+         "manifest": {"obs_schema": 1}},
+        {"metric": "jaxlint_new_findings", "value": 0,
+         "manifest": {"obs_schema": 1}},
+    ]
+    runs.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    proc = _run([str(BENCH_COMPARE), _bench_artifact(tmp_path, 1, 100.0),
+                 "--runs", str(runs)])
+    assert proc.returncode == 0, proc.stdout
+    assert "jaxlint_new_findings" in proc.stdout  # charted, not gated
+
+
 def test_bench_compare_unparseable_artifact_exits_2(tmp_path):
     bad = tmp_path / "BENCH_r09.json"
     bad.write_text("{not json")
     proc = _run([str(BENCH_COMPARE), str(bad)])
     assert proc.returncode == 2
     assert "cannot parse" in proc.stderr
+
+
+# ------------------------------------------------------------- lint gate ---
+
+def test_lint_sh_chains_both_gates(tmp_path):
+    """tools/lint.sh = jaxlint (vs the committed baseline) + bench_compare;
+    the lint run leaves a runs.jsonl line when $BLOCKSIM_RUNS_JSONL is set."""
+    runs = tmp_path / "runs.jsonl"
+    proc = subprocess.run(
+        ["bash", str(REPO / "tools" / "lint.sh")],
+        capture_output=True, text=True, timeout=240, cwd=REPO,
+        env={**os.environ, "BLOCKSIM_RUNS_JSONL": str(runs)},
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert "jaxlint" in proc.stdout and "no regression" in proc.stdout
+    recs = [json.loads(ln) for ln in runs.read_text().strip().splitlines()]
+    lint_recs = [r for r in recs if r.get("metric") == "jaxlint_new_findings"]
+    assert lint_recs and lint_recs[-1]["value"] == 0
+    assert lint_recs[-1]["manifest"]["obs_schema"] == obs.OBS_SCHEMA
 
 
 # --------------------------------------------------------------- health ----

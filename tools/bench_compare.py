@@ -111,11 +111,21 @@ def trajectory(rows: list[dict]) -> dict[str, list[dict]]:
     return by_metric
 
 
+# Lower-is-better counters (e.g. jaxlint's "jaxlint_new_findings") are
+# charted but never gated here: the drop-means-regression rule below is for
+# throughput metrics, and a findings INCREASE already fails the lint gate's
+# own exit code — applying the throughput rule would flag *fixing* findings
+# as a regression.
+UNGATED_SUFFIXES = ("_findings",)
+
+
 def check_regressions(by_metric: dict, threshold: float) -> list[str]:
     """Newest numeric value vs its predecessor, per metric: regressed when
     ``last < (1 - threshold) * prev``."""
     failures = []
     for metric, rows in by_metric.items():
+        if metric.endswith(UNGATED_SUFFIXES):
+            continue
         vals = [r["value"] for r in rows if isinstance(r["value"], (int, float))]
         if len(vals) < 2:
             continue

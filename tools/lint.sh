@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# CI gate: jaxlint (new findings vs LINT_BASELINE.json) chained with the
+# bench_compare perf-regression gate over the committed BENCH_*.json history.
+#
+# Exit 0 only when BOTH pass:
+#   - `python -m blockchain_simulator_tpu.lint --format json` reports zero
+#     non-baselined findings (exit 1 on any new finding, 2 on parse errors);
+#   - `tools/bench_compare.py` sees no metric drop beyond its threshold.
+#
+# When $BLOCKSIM_RUNS_JSONL is set the lint run itself lands in runs.jsonl
+# (one line, metric "jaxlint_new_findings") via utils/obs.py, so the findings
+# trajectory is charted by bench_compare next to the perf history.
+#
+# Usage: tools/lint.sh [--threshold 0.5]
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+
+rc=0
+
+echo "== jaxlint =="
+python -m blockchain_simulator_tpu.lint \
+    blockchain_simulator_tpu tools bench.py --format json
+lint_rc=$?
+if [ "$lint_rc" -ne 0 ]; then
+    echo "lint.sh: jaxlint FAILED (rc=$lint_rc)" >&2
+    rc=1
+fi
+
+echo "== bench_compare =="
+if [ -n "${BLOCKSIM_RUNS_JSONL:-}" ] && [ -f "${BLOCKSIM_RUNS_JSONL}" ]; then
+    python tools/bench_compare.py --runs "${BLOCKSIM_RUNS_JSONL}" "$@"
+else
+    python tools/bench_compare.py "$@"
+fi
+bench_rc=$?
+if [ "$bench_rc" -ne 0 ]; then
+    echo "lint.sh: bench_compare FAILED (rc=$bench_rc)" >&2
+    rc=1
+fi
+
+exit $rc

@@ -94,43 +94,49 @@ V5E_HBM_BYTES_S = 819e9  # single-chip HBM bandwidth, public v5e spec
 
 
 def _measure(cfg, batch: int):
-    """Compile+warm+measure one config; returns (value, rounds_done, wall_s,
-    compile_s, cost) — ``cost`` is XLA's own {flops, bytes accessed} of the
-    compiled executable (None if unavailable), the basis of the roofline
-    fields on the result line (VERDICT r4 weak-#6: state utilization on the
-    headline artifact; tools/roofline_round.py is the standalone variant)."""
+    """AOT-compile + warm + measure one config; returns (value, rounds_done,
+    wall_s, compile_s, cost) — ``cost`` is XLA's own {flops, bytes accessed}
+    of the compiled executable (None if unavailable), the basis of the
+    roofline fields on the result line (VERDICT r4 weak-#6;
+    tools/roofline_round.py is the standalone variant).
+
+    Compilation is staged explicitly through the executable registry
+    (utils/aotcache.aot_cached): ``compile_s`` measures ONLY the
+    trace+lower+XLA (or persistent-cache deserialize) stage — a registry
+    hit (degrade-retry at an already-bucketed rounds value) or a
+    $BLOCKSIM_COMPILE_CACHE disk hit reports near-zero; the warm execution
+    that used to be folded into compile_s is excluded on every path, so the
+    number is comparable across cold/warm runs."""
     import jax
     import jax.numpy as jnp
 
     from blockchain_simulator_tpu.models.base import get_protocol
     from blockchain_simulator_tpu.runner import make_sim_fn
+    from blockchain_simulator_tpu.utils import aotcache
     from blockchain_simulator_tpu.utils.sync import force_sync
 
     sim = make_sim_fn(cfg)
     if batch > 1:
-        run = jax.jit(jax.vmap(sim))
+        # not a per-call recompile: the lambda only runs on a registry MISS
+        # (aot_cached memoizes per (cfg, batch, avals)), so the vmap wrapper
+        # and its compile happen at most once per config
+        build = lambda: jax.jit(jax.vmap(sim))  # jaxlint: disable=static-arg-recompile-hazard
         keys = lambda base: jax.vmap(jax.random.key)(
             jnp.arange(batch, dtype=jnp.uint32) + base
         )
     else:
-        run = sim
+        build = lambda: sim
         keys = lambda base: jax.random.key(base)
     tc = time.perf_counter()
+    run, info = aotcache.aot_cached("bench", build, (keys(0),), cfg=cfg,
+                                    extra=batch)
+    compile_s = time.perf_counter() - tc  # ~0 on a registry hit
+    cost = info.get("cost")
     # force_sync, not block_until_ready: on this env's axon backend
     # block_until_ready has returned before execution finished, inflating
     # throughput ~1000x (KNOWN_ISSUES.md #1); force_sync reads back a scalar,
     # a data dependency that cannot be satisfied early.
-    final = force_sync(run(keys(0)))  # compile + warm
-    compile_s = time.perf_counter() - tc
-    cost = None
-    try:
-        ca = run.lower(keys(0)).compile().cost_analysis()  # cached compile
-        if isinstance(ca, list):
-            ca = ca[0]
-        cost = {"flops": float(ca.get("flops", 0.0)),
-                "bytes": float(ca.get("bytes accessed", 0.0))}
-    except Exception:  # cost analysis is evidence, never a failure mode
-        pass
+    final = force_sync(run(keys(0)))  # warm (excluded from compile_s)
     t0 = time.perf_counter()
     final = force_sync(run(keys(100)))
     wall = time.perf_counter() - t0
@@ -144,6 +150,47 @@ def _measure(cfg, batch: int):
     else:
         rounds_done = int(proto.metrics(cfg, final)["blocks_final_all_nodes"])
     return rounds_done / wall, rounds_done, wall, compile_s, cost
+
+
+def _round_bucket(rounds: int) -> int:
+    """Round a requested round count UP to the 1-2-5 decade grid (200, 500,
+    1000, 2000, 5000, ...).  Every compiled executable is keyed on the
+    config, and ``rounds`` feeds sim_ms/max_rounds/max_slots — bucketing
+    collapses the space of requested counts onto a tiny canonical set so
+    degrade-retries and repeat invocations (persistent cache,
+    $BLOCKSIM_COMPILE_CACHE) reuse one executable instead of recompiling
+    ~20 s of XLA per value.  The defaults (200, 2000) are already on the
+    grid, so default behavior is unchanged; throughput is rounds/s, so
+    running a slightly larger bucket moves wall, not the metric."""
+    if rounds <= 0:
+        return rounds
+    m = 1
+    while True:
+        for k in (1, 2, 5):
+            if k * m >= rounds:
+                return k * m
+        m *= 10
+
+
+def _degraded_rounds(remaining_s: float, prev, prev_rounds: int, want: int):
+    """Largest 1-2-5 bucket strictly between ``prev_rounds`` and ``want``
+    whose projected cost (compile ~ prev attempt's + 2 runs scaled by
+    rounds) fits ``remaining_s`` — the degrade-retry target when the full
+    scale-up no longer fits the child budget.  None when nothing fits
+    (the prev attempt's result stands)."""
+    cand = _round_bucket(want) if want > 0 else 0
+    while cand > prev_rounds:
+        # walk one step down the 1-2-5 grid
+        s = str(cand)
+        head, zeros = int(s[0]), len(s) - 1
+        down = {1: 5, 2: 1, 5: 2}[head]
+        cand = down * 10 ** (zeros - 1 if head == 1 else zeros)
+        if cand <= prev_rounds:
+            return None
+        projected = prev[3] + 2 * prev[2] * (cand / max(prev_rounds, 1)) + 20
+        if remaining_s >= projected:
+            return cand
+    return None
 
 
 def _cfg(rounds: int):
@@ -217,6 +264,16 @@ def child() -> None:
         os.environ.get("BENCH_CHILD_DEADLINE_S", "1e9")
     )
 
+    # persistent compile caches (utils/aotcache.py): serialized executables
+    # when $BLOCKSIM_COMPILE_CACHE is set, jax's own compilation cache when
+    # $BLOCKSIM_XLA_CACHE is set — a second (warm) bench invocation then
+    # reports near-zero compile_s (tools/warm_bench.sh measures the pair).
+    # Both are no-ops when the env vars are unset; neither touches a
+    # backend here (config-level only).
+    from blockchain_simulator_tpu.utils import aotcache
+
+    aotcache.enable_xla_cache()
+
     # The env's sitecustomize forces jax_platforms="axon,cpu" at the config
     # level, so the env var alone does not stick (see tests/conftest.py);
     # re-assert a caller-requested CPU run before any backend init.
@@ -289,10 +346,14 @@ def child() -> None:
                      rounds=rounds_done)
         print(json.dumps(rec), flush=True)
 
-    ladder = [r for r in (ROUNDS_FIRST, ROUNDS) if r > 0]
+    # round-bucketed ladder: every attempt lands on the 1-2-5 grid so
+    # degrade-retries and repeat invocations reuse one executable (the
+    # defaults 200/2000 are already on the grid — behavior unchanged)
+    ladder = [_round_bucket(r) for r in (ROUNDS_FIRST, ROUNDS) if r > 0]
     if len(ladder) == 2 and ladder[0] >= ladder[1]:
-        ladder = [ROUNDS]
+        ladder = [_round_bucket(ROUNDS)]
     prev = None  # (value, rounds, wall, compile_s) of previous attempt
+    prev_rounds = 0
     for i, rounds in enumerate(ladder):
         remaining = child_deadline - time.monotonic()
         if prev is None:
@@ -304,24 +365,40 @@ def child() -> None:
         else:
             # Scale-up attempt: recompile (~same as first compile) + 2 runs at
             # rounds/prev_rounds times the measured wall.  Only start what fits.
-            scale = rounds / max(ladder[i - 1], 1)
+            scale = rounds / max(prev_rounds, 1)
             projected = prev[3] + 2 * prev[2] * scale + 20
             if remaining < projected:
+                # degrade-retry: instead of giving up on the scale-up, drop
+                # to the largest grid bucket that fits the remaining budget
+                # (projected WITH a full compile — a fresh bucket pays its
+                # XLA in-process; grid buckets exist so repeat invocations
+                # hit the persistent cache and a re-requested bucket hits
+                # the registry, where the retry pays runs, not XLA)
+                deg = _degraded_rounds(remaining, prev, prev_rounds, rounds)
+                if deg is None:
+                    print(
+                        f"bench-child: skipping rounds={rounds}: projected "
+                        f"{projected:.0f}s > remaining {remaining:.0f}s",
+                        file=sys.stderr,
+                    )
+                    return
                 print(
-                    f"bench-child: skipping rounds={rounds}: projected "
-                    f"{projected:.0f}s > remaining {remaining:.0f}s",
+                    f"bench-child: degrading rounds {rounds} -> {deg} to fit "
+                    f"remaining {remaining:.0f}s",
                     file=sys.stderr,
                 )
-                return
+                rounds = deg
         cfg_r = _cfg(rounds)
         value, rounds_done, wall, compile_s, cost = _measure(cfg_r, batch)
         emit(value, rounds_done, wall, compile_s, rounds, cost=cost, cfg=cfg_r)
         prev = (value, rounds_done, wall, compile_s)
+        prev_rounds = rounds
 
     # ---- companion: serialization-on model (same fast path, shifted wave) --
     if ROUNDS_SER > 0 and prev is not None:
+        rounds_ser = _round_bucket(ROUNDS_SER)
         remaining = child_deadline - time.monotonic()
-        projected = prev[3] + 2 * prev[2] * (ROUNDS_SER / max(ladder[-1], 1)) + 20
+        projected = prev[3] + 2 * prev[2] * (rounds_ser / max(prev_rounds, 1)) + 20
         if remaining < projected:
             print(
                 f"bench-child: skipping serialization_on companion: projected "
@@ -329,9 +406,9 @@ def child() -> None:
                 file=sys.stderr,
             )
             return
-        cfg_s = _cfg_ser(ROUNDS_SER)
+        cfg_s = _cfg_ser(rounds_ser)
         value, rounds_done, wall, compile_s, cost = _measure(cfg_s, batch)
-        emit(value, rounds_done, wall, compile_s, ROUNDS_SER, cost=cost,
+        emit(value, rounds_done, wall, compile_s, rounds_ser, cost=cost,
              tag="serialization_on", cfg=cfg_s)
 
 

@@ -9,8 +9,11 @@ so nothing is ever reused.  On this repo's configs a single wasted recompile
 is minutes of XLA:CPU time (the 100k-node program alone is ~7 min,
 bench.py's fallback notes), which is why every real factory in the tree
 (``runner.make_sim_fn``, ``utils/trace.py``'s traced fns,
-``parallel/shard.py``'s sharded builders) is ``functools.lru_cache``-d on a
-hashable SimConfig.
+``parallel/sweep.py``'s batched builders) is memoized on a hashable
+SimConfig — today through the unified executable registry
+(``utils/aotcache.cached_factory``), historically ``functools.lru_cache``
+(``parallel/shard.py`` still uses it); both count as sanctioned cache
+decorators here.
 
 The rule flags jit application inside a function whose enclosing chain has
 no ``lru_cache``/``cache`` decorator when the jitted callable (or the jit
@@ -31,7 +34,16 @@ SUMMARY = ("jit built per call over enclosing-scope captures without an "
            "(runner.make_sim_fn is the sanctioned pattern)")
 
 JIT_NAMES = frozenset({"jax.jit", "jax.pmap"})
-CACHED_DECOS = frozenset({"functools.lru_cache", "functools.cache"})
+# Sanctioned cache decorators: functools' memoizers, plus the unified
+# executable registry's factory decorator (utils/aotcache.cached_factory —
+# the keyed LRU store that replaced the per-module lru_caches; it memoizes
+# on the same hashable-args contract, with hit/miss stats on the manifest).
+CACHED_DECOS = frozenset({
+    "functools.lru_cache",
+    "functools.cache",
+    "aotcache.cached_factory",
+    "blockchain_simulator_tpu.utils.aotcache.cached_factory",
+})
 
 
 def _is_cached(fn: ast.AST, aliases: dict[str, str]) -> bool:

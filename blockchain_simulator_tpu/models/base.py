@@ -60,3 +60,65 @@ def fault_masks(cfg, n: int):
     alive = ids < (n - nc)
     honest = ids < (n - nc - f.n_byzantine)
     return jnp.asarray(alive), jnp.asarray(honest)
+
+
+def dyn_fault_masks(n: int, n_crashed, n_byzantine):
+    """:func:`fault_masks` with the counts as TRACED operands.
+
+    Same id layout (crashed = last ids, Byzantine = last alive ids before
+    them), same int comparisons — bit-identical to the static masks at equal
+    counts — but ``n_crashed`` / ``n_byzantine`` are scalar arrays, so one
+    compiled program serves every fault level of a sweep
+    (runner.make_dyn_sim_fn / parallel/sweep.py)."""
+    ids = jnp.arange(n)
+    nc = jnp.asarray(n_crashed, jnp.int32)
+    nb = jnp.asarray(n_byzantine, jnp.int32)
+    alive = ids < (n - nc)
+    honest = ids < (n - nc - nb)
+    return alive, honest
+
+
+def canonical_fault_cfg(cfg):
+    """The ONE static config whose dynamic-operand trace serves every
+    (n_crashed, n_byzantine) point of a count sweep: counts zeroed to the
+    FaultConfig defaults so every sweep over the same fault *structure*
+    (drop_prob, byz_forge, byz_copies) shares one registry key.
+
+    ``byz_forge`` keeps a static ``n_byzantine=1`` sentinel: pbft.step
+    includes the forge wave in the trace only when the static count is
+    positive, and the wave is driven by the traced ``alive & ~honest``
+    forger mask — all-false at a dynamic f=0, where adding zero forged
+    votes is bit-identical to the static f=0 program that omits the wave
+    (the forge block consumes no PRNG keys)."""
+    import dataclasses
+
+    f = cfg.faults
+    return cfg.with_(
+        faults=dataclasses.replace(
+            f,
+            crash_frac=0.0,
+            n_crashed=-1,
+            n_byzantine=1 if f.byz_forge else 0,
+        )
+    )
+
+
+def apply_fault_masks(cfg, state, alive, honest):
+    """Install traced fault masks into a state freshly init'd at the
+    canonical (fault-free) config — bit-equal to ``init`` at the static
+    config with those counts.
+
+    Every protocol carries the masks as plain ``alive``/``honest`` state
+    fields; raft additionally derives its initial election schedule from
+    them (crashed nodes never start an election, models/raft.py init), so
+    the disarm is re-applied here against the traced mask.  The mixed shard
+    sim distributes faults per shard at init and is NOT supported
+    (runner.make_dyn_sim_fn refuses it)."""
+    state = state.replace(alive=alive, honest=honest)
+    if cfg.protocol == "raft":
+        from blockchain_simulator_tpu.models.raft import DISARM
+
+        state = state.replace(
+            election_deadline=jnp.where(alive, state.election_deadline, DISARM)
+        )
+    return state

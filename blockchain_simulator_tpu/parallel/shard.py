@@ -10,14 +10,13 @@ equivalent of the reference's simulated point-to-point channels).
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from blockchain_simulator_tpu.models.base import get_protocol
 from blockchain_simulator_tpu.parallel.mesh import NODES_AXIS
+from blockchain_simulator_tpu.utils import aotcache
 from blockchain_simulator_tpu.utils import prng
 from blockchain_simulator_tpu.utils.config import SimConfig
 
@@ -82,7 +81,7 @@ def node_specs(state, bufs, global_fields=()):
     return state_specs(state, global_fields), bufs_spec
 
 
-@functools.lru_cache(maxsize=64)
+@aotcache.cached_factory("shard-round")
 def _make_sharded_round_fn(cfg: SimConfig, mesh: Mesh):
     """Node-sharded round-blocked PBFT fast path (models/pbft_round.py):
     one scan step per 50 ms block interval, node state row-sharded, the
@@ -115,7 +114,7 @@ def _make_sharded_round_fn(cfg: SimConfig, mesh: Mesh):
     return sim
 
 
-@functools.lru_cache(maxsize=64)
+@aotcache.cached_factory("shard-raft-hb")
 def _make_sharded_raft_hb_fn(cfg: SimConfig, mesh: Mesh):
     """Node-sharded heartbeat-blocked raft fast path (models/raft_hb.py):
     the tick-engine election prefix runs sharded exactly like the general
@@ -150,7 +149,7 @@ def _make_sharded_raft_hb_fn(cfg: SimConfig, mesh: Mesh):
     return sim
 
 
-@functools.lru_cache(maxsize=64)
+@aotcache.cached_factory("shard-mixed")
 def _make_sharded_mixed_fast_fn(cfg: SimConfig, mesh: Mesh):
     """Shard-sharded heartbeat-scheduled mixed sim (models/mixed.scan_fast):
     raft shard rows over the mesh axis, the S-representative PBFT layer
@@ -183,7 +182,7 @@ def _make_sharded_mixed_fast_fn(cfg: SimConfig, mesh: Mesh):
     return sim
 
 
-@functools.lru_cache(maxsize=64)
+@aotcache.cached_factory("shard-sim")
 def make_sharded_sim_fn(cfg: SimConfig, mesh: Mesh):
     """Jitted ``sim(key) -> final_state`` with node state sharded over the
     mesh's ``nodes`` axis.  ``cfg.n`` must divide by the axis size.

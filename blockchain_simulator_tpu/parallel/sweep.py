@@ -3,30 +3,51 @@
 The outer-axis analog of BASELINE config 4 ("Byzantine-fault sweep f=0..n/3,
 pmap over fault configs"): many seeds of one config run as a single vmapped
 program; over a mesh, the batch axis shards over ``sweep`` (``spmd_axis_name``)
-while the node axis shards over ``nodes``.  Fault *structure* (crash counts,
-Byzantine counts) is static per config, so an f-sweep compiles one program per
-f value but batches all seeds of that f.
+while the node axis shards over ``nodes``.
+
+Fault *counts* (crash counts, Byzantine counts) are traced per-run OPERANDS
+(runner.make_dyn_sim_fn): an f-sweep over any number of fault levels is ONE
+vmapped executable over the (fault level, seed) cross product — where it used
+to compile one program per f value (~20 s of XLA per point on this box for
+seconds of simulation).  Fault *structure* (drop_prob, byz_forge, byz_copies)
+stays static: :func:`run_fault_sweep` groups its fault configs by canonical
+structure (models/base.canonical_fault_cfg) and compiles once per group.
+Results are bit-equal to the per-point static path (pinned in
+tests/test_zsweep_cache.py); the mixed shard sim keeps the static path.
+
+Bit-equality caveat: under ``stat_sampler="exact"`` (and the whole edge
+path) equality is exact — integer draws whose arithmetic is identical in
+both programs.  The ``"normal"`` CLT sampler (auto at n >= 4096) has a
+float path that XLA may arrange differently in the two compiled programs:
+with the SAME keys, one message can land one delay bucket over, moving a
+commit tail by ±1 tick (measured once across a 22-point 10k sweep,
+``tools/sweep_cache_bench.py`` notes) — the same jitter class
+models/pbft_round.py documents vs the tick engine; counts and milestones
+are unaffected.
+
+Compiled programs live in the unified executable registry
+(utils/aotcache.py) — hit/miss stats land on every run manifest.
 """
 
 from __future__ import annotations
 
-import functools
+import dataclasses
 
 import jax
 import jax.numpy as jnp
 
-from blockchain_simulator_tpu.models.base import get_protocol
+from blockchain_simulator_tpu.models.base import canonical_fault_cfg, get_protocol
 from blockchain_simulator_tpu.parallel.mesh import SWEEP_AXIS
-from blockchain_simulator_tpu.runner import make_sim_fn
-from blockchain_simulator_tpu.utils import obs
+from blockchain_simulator_tpu.runner import make_dyn_sim_fn, make_sim_fn
+from blockchain_simulator_tpu.utils import aotcache, obs
 from blockchain_simulator_tpu.utils.config import SimConfig
 
 
-@functools.lru_cache(maxsize=32)
+@aotcache.cached_factory("sweep-batched")
 def _batched_fn(cfg: SimConfig, mesh=None):
-    """Jitted ``batched(keys) -> finals`` for one (cfg, mesh): cached so
-    repeated sweeps of one config reuse the compiled program instead of
-    building a fresh jit wrapper per call (jaxlint
+    """Jitted ``batched(keys) -> finals`` for one (cfg, mesh): registry-
+    cached so repeated sweeps of one config reuse the compiled program
+    instead of building a fresh jit wrapper per call (jaxlint
     static-arg-recompile-hazard; runner.make_sim_fn convention)."""
     if mesh is None:
         return jax.jit(jax.vmap(make_sim_fn(cfg)))
@@ -35,6 +56,14 @@ def _batched_fn(cfg: SimConfig, mesh=None):
     return jax.jit(
         jax.vmap(make_sharded_sim_fn(cfg, mesh), spmd_axis_name=SWEEP_AXIS)
     )
+
+
+@aotcache.cached_factory("sweep-batched-dynf")
+def _dyn_batched_fn(cfg: SimConfig):
+    """Jitted ``batched(keys, n_crashed[B], n_byzantine[B]) -> finals`` —
+    THE one executable of a whole fault-count sweep (``cfg`` must already be
+    canonical; one registry entry per fault structure)."""
+    return jax.jit(jax.vmap(make_dyn_sim_fn(cfg)))
 
 
 def run_seed_sweep(cfg: SimConfig, seeds, mesh=None):
@@ -68,18 +97,76 @@ def run_seed_sweep(cfg: SimConfig, seeds, mesh=None):
     return out
 
 
+def _dyn_operands(cfg: SimConfig, fc) -> tuple[int, int]:
+    """The traced (n_crashed, n_byzantine) operand point of a fault config."""
+    return fc.resolved_n_crashed(cfg.n), fc.n_byzantine
+
+
+def _run_dyn_group(cfg: SimConfig, canon: SimConfig, fcs, seeds):
+    """One compiled program for every (fault config, seed) point of a
+    same-structure group; returns {fc: [metrics per seed]} with rows
+    bit-equal to ``run_seed_sweep(cfg.with_(faults=fc), seeds)``."""
+    n_s = len(seeds)
+    seed_rep = list(seeds) * len(fcs)
+    keys = jax.vmap(jax.random.key)(jnp.asarray(seed_rep, jnp.uint32))
+    ncs, nbs = zip(*(_dyn_operands(cfg, fc) for fc in fcs))
+    nc = jnp.repeat(jnp.asarray(ncs, jnp.int32), n_s)
+    nb = jnp.repeat(jnp.asarray(nbs, jnp.int32), n_s)
+    finals = jax.block_until_ready(_dyn_batched_fn(canon)(keys, nc, nb))
+    results = {}
+    for i, fc in enumerate(fcs):
+        cfg_fc = cfg.with_(faults=fc)
+        proto = get_protocol(cfg_fc.protocol)
+        rows = []
+        for j, seed in enumerate(seeds):
+            final_ij = jax.tree.map(lambda x: x[i * n_s + j], finals)
+            m = proto.metrics(cfg_fc, final_ij)
+            obs.record_run({"seed": int(seed), **m}, cfg_fc)
+            rows.append(m)
+        results[fc] = rows
+    return results
+
+
 def run_fault_sweep(cfg: SimConfig, fault_configs, seeds):
-    """BASELINE config 4: one batched run per fault config (static structure),
-    seeds vmapped inside.  Returns {fault_config: [metrics per seed]}."""
+    """BASELINE config 4: sweep fault configs with seeds vmapped inside.
+    Returns {fault_config: [metrics per seed]}.
+
+    Fault configs that differ only in their COUNTS (crash/Byzantine) batch
+    into one dynamic-operand executable per structure group — the whole
+    default sweep is ONE compile.  Structurally distinct configs (different
+    drop_prob / byz_forge / byz_copies) land in separate groups, each with
+    its own dynamic-operand compile — same compile count as the old
+    per-config loop, and future same-structure sweeps reuse the entry.
+    Only the mixed shard sim takes the static ``run_seed_sweep`` path
+    (one static compile per fault config)."""
+    fault_configs = list(fault_configs)
+    groups: dict[SimConfig, list] = {}
+    order = {}
+    for fc in fault_configs:
+        if cfg.protocol == "mixed":
+            order[fc] = None
+            continue
+        canon = canonical_fault_cfg(cfg.with_(faults=fc))
+        if fc not in groups.setdefault(canon, []):
+            groups[canon].append(fc)
+        order[fc] = canon
+    done: dict = {}
+    for canon, fcs in groups.items():
+        done.update(_run_dyn_group(cfg, canon, fcs, seeds))
     results = {}
     for fc in fault_configs:
-        results[fc] = run_seed_sweep(cfg.with_(faults=fc), seeds)
+        if order[fc] is None:
+            results[fc] = run_seed_sweep(cfg.with_(faults=fc), seeds)
+        else:
+            results[fc] = done[fc]
     return results
 
 
 def run_byzantine_sweep(cfg: SimConfig, f_values=None, seeds=(0,), forge=True):
     """BASELINE config 4 end-to-end: sweep the Byzantine count f over
-    ``f_values`` (default 0..(n-1)//3), seeds batched per f.
+    ``f_values`` (default 0..(n-1)//3), seeds batched per f — the whole
+    sweep is ONE vmapped executable over (f, seed) (dynamic fault operands;
+    the per-f recompile this loop used to pay is gone).
 
     Each entry reports the two safety-relevant outcomes next to the fault
     level: ``forged_commits`` (a slot finalized although no honest leader ever
@@ -87,8 +174,6 @@ def run_byzantine_sweep(cfg: SimConfig, f_values=None, seeds=(0,), forge=True):
     utils/config.py quorum_rule) and ``agreement_ok``.  Returns a list of
     {"f": f, "seed": s, **metrics} dicts.
     """
-    import dataclasses
-
     if forge and cfg.protocol != "pbft":
         raise ValueError(
             "the forging attack is implemented for pbft only; pass "
@@ -97,9 +182,15 @@ def run_byzantine_sweep(cfg: SimConfig, f_values=None, seeds=(0,), forge=True):
         )
     if f_values is None:
         f_values = range(cfg.byz_f + 1)
+    f_values = list(f_values)
+    fcs = [
+        dataclasses.replace(cfg.faults, n_byzantine=f, byz_forge=forge)
+        for f in f_values
+    ]
+    # dedup: repeated f values share one fault config (and one batch row set)
+    res = run_fault_sweep(cfg, list(dict.fromkeys(fcs)), seeds)
     out = []
-    for f in f_values:
-        faults = dataclasses.replace(cfg.faults, n_byzantine=f, byz_forge=forge)
-        for seed, m in zip(seeds, run_seed_sweep(cfg.with_(faults=faults), seeds)):
+    for f, fc in zip(f_values, fcs):
+        for seed, m in zip(seeds, res[fc]):
             out.append({"f": int(f), "seed": int(seed), **m})
     return out

@@ -14,8 +14,9 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from blockchain_simulator_tpu.models import base as base_model
 from blockchain_simulator_tpu.models.base import get_protocol
-from blockchain_simulator_tpu.utils import prng
+from blockchain_simulator_tpu.utils import aotcache, prng
 from blockchain_simulator_tpu.utils.config import SimConfig
 from blockchain_simulator_tpu.utils.sync import force_sync
 
@@ -143,7 +144,7 @@ def _reject_cpp_only(cfg: SimConfig) -> None:
                 )
 
 
-@functools.lru_cache(maxsize=64)
+@aotcache.cached_factory("sim")
 def make_sim_fn(cfg: SimConfig):
     """Build (and cache) the jitted end-to-end simulation function for a config.
 
@@ -154,6 +155,11 @@ def make_sim_fn(cfg: SimConfig):
     checked handoff (models/raft_hb.py), or the heartbeat-scheduled mixed
     sim (models/mixed.scan_fast).  Every returned function is fully traced
     (no host branches), so it composes with vmap and shard_map.
+
+    Caching lives in the unified executable registry (utils/aotcache.py,
+    hit/miss stats on every run manifest) rather than a per-module
+    ``lru_cache``; the callable per config is still built exactly once per
+    process.
     """
     _reject_cpp_only(cfg)
     if use_round_schedule(cfg):
@@ -199,6 +205,74 @@ def make_sim_fn(cfg: SimConfig):
     return sim
 
 
+def make_dyn_sim_fn(cfg: SimConfig):
+    """Build the dynamic-fault-operand simulation function for a config:
+    ``sim(key, n_crashed, n_byzantine) -> final_state`` with the fault
+    COUNTS as traced scalars (fault masks computed inside the trace,
+    models/base.dyn_fault_masks), so one compiled program serves every
+    fault level of a sweep — the compile-once substrate of
+    parallel/sweep.run_fault_sweep / run_byzantine_sweep.
+
+    ``cfg`` is canonicalized (models/base.canonical_fault_cfg) so every
+    sweep over the same fault *structure* shares one trace; at equal
+    counts the result is bit-equal to ``make_sim_fn`` at the static config
+    (pinned in tests/test_zsweep_cache.py).  Returns the UNJITTED function:
+    the sweep layer owns the single ``jit(vmap(...))`` wrapper, so an
+    f-sweep costs exactly one executable.  The mixed shard sim distributes
+    faults per shard at init and is refused."""
+    cfg = base_model.canonical_fault_cfg(cfg)
+    _reject_cpp_only(cfg)
+    if cfg.protocol == "mixed":
+        raise NotImplementedError(
+            "dynamic fault operands are not implemented for the mixed shard "
+            "sim (faults live at the raft-shard level, models/mixed.py); "
+            "sweep it with one static compile per fault config"
+        )
+    n = cfg.n
+
+    if use_round_schedule(cfg):
+        if cfg.protocol == "raft":
+            from blockchain_simulator_tpu.models import raft as raft_tick
+            from blockchain_simulator_tpu.models import raft_hb
+
+            def sim_hb(key, n_crashed, n_byzantine):
+                state, bufs = raft_tick.init(cfg, jax.random.fold_in(key, 0x1217))
+                state = base_model.apply_fault_masks(
+                    cfg, state, *base_model.dyn_fault_masks(n, n_crashed, n_byzantine)
+                )
+                return raft_hb.scan_from_init(cfg, state, bufs, key)
+
+            return sim_hb
+        from blockchain_simulator_tpu.models import pbft_round
+
+        def sim_round(key, n_crashed, n_byzantine):
+            state, _ = pbft_round.init(cfg, jax.random.fold_in(key, 0x1217))
+            state = base_model.apply_fault_masks(
+                cfg, state, *base_model.dyn_fault_masks(n, n_crashed, n_byzantine)
+            )
+            return pbft_round.scan_rounds(cfg, state, key)
+
+        return sim_round
+
+    proto = get_protocol(cfg.protocol)
+
+    def sim(key, n_crashed, n_byzantine):
+        state, bufs = proto.init(cfg, jax.random.fold_in(key, 0x1217))
+        state = base_model.apply_fault_masks(
+            cfg, state, *base_model.dyn_fault_masks(n, n_crashed, n_byzantine)
+        )
+
+        def body(carry, t):
+            st, bf = carry
+            st, bf = proto.step(cfg, st, bf, t, prng.tick_key(key, t))
+            return (st, bf), ()
+
+        (state, bufs), _ = jax.lax.scan(body, (state, bufs), jnp.arange(cfg.ticks))
+        return state
+
+    return sim
+
+
 def run_simulation(cfg: SimConfig, seed: int | None = None, with_timing: bool = False):
     """Run one simulation; returns the protocol's structured metrics dict
     (the reference's NS_LOG lines, SURVEY.md §5, as data).
@@ -232,7 +306,7 @@ def final_state(cfg: SimConfig, seed: int | None = None):
     return jax.block_until_ready(sim(key))
 
 
-@functools.lru_cache(maxsize=64)
+@aotcache.cached_factory("segment")
 def make_segment_fn(cfg: SimConfig, n_ticks: int):
     """Jitted ``seg(key, state, bufs, t0) -> (state, bufs)`` advancing the
     simulation ``n_ticks`` ticks from traced start tick ``t0``.  Because tick

@@ -1,0 +1,396 @@
+"""Unified executable registry + persistent AOT compile caching.
+
+Compilation dominates end-to-end wall on every sweep-shaped workload this
+repo cares about: the committed ``BENCH_*.json`` history shows ``compile_s``
+at 20-23 s against ~18 s of run wall, and a 0..33 Byzantine f-sweep used to
+pay one full XLA compile PER FAULT LEVEL for seconds of actual simulation.
+This module is the one place compiled programs live:
+
+- **In-process registry** (:class:`ExecutableRegistry`, module singleton
+  :data:`registry`): a single keyed LRU store that subsumes the scattered
+  ``functools.lru_cache`` factories (``runner.make_sim_fn``,
+  ``utils/trace.py``'s traced fns, ``parallel/sweep._batched_fn``).  Factory
+  functions opt in with :func:`cached_factory` — the jaxlint
+  ``static-arg-recompile-hazard`` rule recognizes it as a sanctioned cache
+  decorator, same as ``functools.lru_cache``.  Hit/miss/eviction stats are
+  exported into every run manifest (``utils/obs.py`` ``cache`` block).
+- **AOT staging** (:func:`aot_compile`): explicit
+  ``jit(f).lower(*args).compile()`` with the executable's own cost analysis
+  attached — the compile-vs-run split every timing surface wants, without a
+  throwaway first execution.
+- **Persistent on-disk layer**: with ``$BLOCKSIM_COMPILE_CACHE`` set,
+  :func:`aot_compile` round-trips executables through
+  ``jax.experimental.serialize_executable`` (measured WORKING on this
+  container's jax 0.4.37 / XLA:CPU — bit-equal metrics across processes,
+  ~1 s deserialize vs ~8-20 s trace+lower+compile; KNOWN_ISSUES.md #0e,
+  repro: ``tools/repro_exe_serialize.py``).  Independently,
+  :func:`enable_xla_cache` points jax's own compilation cache
+  (``jax_compilation_cache_dir``) at ``$BLOCKSIM_XLA_CACHE`` so even
+  non-AOT ``jit`` calls skip XLA re-optimization across processes.
+
+Design constraints:
+
+- **Never touch a backend at import** (jaxlint module-scope-backend-touch;
+  KNOWN_ISSUES.md #3: backend init can hang ~25 min on a wedged tunnel).
+  This module does not even import jax at module scope — ``utils/obs.py``
+  imports it from the bench PARENT process, which deliberately never
+  initializes jax.
+- **Corrupt or stale disk entries must never take down a run**: every
+  persistent-layer failure falls back to a fresh compile and is counted in
+  the stats instead of raised.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import hashlib
+import os
+import pickle
+import sys
+import threading
+import time
+
+# Persistent serialized-executable directory (unset = in-process only).
+PERSIST_ENV = "BLOCKSIM_COMPILE_CACHE"
+# jax's own compilation-cache directory (unset = disabled).
+XLA_CACHE_ENV = "BLOCKSIM_XLA_CACHE"
+
+# Bump when the on-disk entry layout changes: stale-format entries are
+# treated as misses, never parsed.
+_DISK_FORMAT = 1
+
+
+def _dist_version(name: str) -> str | None:
+    """Installed package version without importing the package (the
+    utils/obs.py convention)."""
+    try:
+        import importlib.metadata
+
+        return importlib.metadata.version(name)
+    except Exception:
+        return None
+
+
+def _backend_if_initialized() -> str | None:
+    """The active backend name, ONLY if one is already initialized — this
+    function never triggers a backend init of its own (utils/obs.manifest
+    has the incident history)."""
+    if "jax" not in sys.modules:
+        return None
+    try:
+        from jax._src import xla_bridge
+
+        if getattr(xla_bridge, "_backends", None):
+            # guarded: a backend already exists, so this cannot init one
+            return sys.modules["jax"].default_backend()  # jaxlint: disable=module-scope-backend-touch
+    except Exception:
+        pass
+    return None
+
+
+def _display_key(name: str, args: tuple, kwargs: tuple) -> str:
+    """Short human-readable key for stats/manifests: the factory name plus
+    the config hash of the first dataclass argument (the join key used
+    everywhere else in the observability layer)."""
+    import dataclasses
+
+    from blockchain_simulator_tpu.utils import obs
+
+    for a in args + tuple(v for _, v in kwargs):
+        if dataclasses.is_dataclass(a):
+            return f"{name}:{obs.config_hash(a)}"
+    return name
+
+
+class ExecutableRegistry:
+    """Keyed LRU store for built callables/executables with hit/miss stats.
+
+    Keys are ``(factory name, args, kwargs)`` — every factory argument in
+    this repo is hashable (frozen ``SimConfig``, ``jax.sharding.Mesh``,
+    ints), the same property the old per-module ``lru_cache``s relied on.
+    """
+
+    def __init__(self, maxsize: int = 128):
+        self.maxsize = maxsize
+        self._entries: collections.OrderedDict = collections.OrderedDict()
+        self._lock = threading.RLock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.disk_hits = 0
+        self.disk_misses = 0
+        self.disk_saves = 0
+        self.disk_errors = 0
+        self.last_key: str | None = None
+
+    # ---------------------------------------------------------- memoize ---
+    def get(self, name: str, args: tuple, kwargs: dict, build):
+        """Return the cached build for ``(name, args, kwargs)``, building
+        (and recording a miss) when absent.  LRU beyond ``maxsize``."""
+        key = (name, args, tuple(sorted(kwargs.items())))
+        with self._lock:
+            if key in self._entries:
+                self.hits += 1
+                self._entries.move_to_end(key)
+                self.last_key = _display_key(name, args, key[2])
+                return self._entries[key]
+        # build OUTSIDE the lock: builds trace/compile for minutes and must
+        # not serialize unrelated factories behind a single mutex
+        value = build(*args, **kwargs)
+        with self._lock:
+            self.misses += 1
+            self.last_key = _display_key(name, args, key[2])
+            self._entries[key] = value
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.maxsize:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+        return value
+
+    def clear(self, name: str | None = None) -> None:
+        """Drop every entry (``name=None``) or just one factory's entries —
+        the ``lru_cache.cache_clear`` analog ``cached_factory`` wrappers
+        expose (tools/ablate.py patches ops and rebuilds through a cleared
+        ``make_sim_fn``; a shared-store clear must not evict every other
+        factory with it)."""
+        with self._lock:
+            if name is None:
+                self._entries.clear()
+                return
+            for key in [k for k in self._entries if k[0] == name]:
+                del self._entries[key]
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    # ------------------------------------------------------------ stats ---
+    def stats(self) -> dict:
+        """Full stats snapshot (tests, artifacts)."""
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "entries": len(self._entries),
+                "disk_hits": self.disk_hits,
+                "disk_misses": self.disk_misses,
+                "disk_saves": self.disk_saves,
+                "disk_errors": self.disk_errors,
+                "last_key": self.last_key,
+                "persistent_dir": persistent_dir(),
+            }
+
+    def manifest(self) -> dict:
+        """The compact ``cache`` block utils/obs.py attaches to every
+        runs.jsonl line.  Pure counter reads — never touches jax."""
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "key": self.last_key,
+                "persistent_dir": persistent_dir(),
+            }
+
+
+registry = ExecutableRegistry()
+
+
+def cached_factory(name: str):
+    """Decorator: memoize a ``factory(*hashable_args) -> callable`` in the
+    process-wide :data:`registry` (the ``functools.lru_cache`` replacement;
+    jaxlint's static-arg-recompile-hazard sanctions it the same way).
+
+    ``wrapper.__wrapped__`` is the raw factory, as with ``lru_cache``.
+    """
+
+    def deco(build):
+        @functools.wraps(build)
+        def wrapper(*args, **kwargs):
+            return registry.get(name, args, kwargs, build)
+
+        # lru_cache API parity: per-factory invalidation without touching
+        # the other factories sharing the registry (tools/ablate.py relies
+        # on make_sim_fn.cache_clear() between patched-op variants)
+        wrapper.cache_clear = lambda: registry.clear(name)
+        return wrapper
+
+    return deco
+
+
+# ------------------------------------------------------- persistent layer ---
+
+
+def persistent_dir() -> str | None:
+    """Serialized-executable directory ($BLOCKSIM_COMPILE_CACHE), or None."""
+    return os.environ.get(PERSIST_ENV) or None
+
+
+def enable_xla_cache() -> str | None:
+    """Point jax's own compilation cache at ``$BLOCKSIM_XLA_CACHE`` (no-op
+    when unset).  Thresholds are zeroed because on XLA:CPU the default
+    min-compile-time filter would skip exactly the entries a 2-core box
+    needs.  Returns the directory when enabled."""
+    path = os.environ.get(XLA_CACHE_ENV)
+    if not path:
+        return None
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    return path
+
+
+def _disk_key(name: str, cfg, example_args, extra) -> str:
+    """Content hash of everything that must match for a serialized
+    executable to be valid: factory name, canonical config, input avals,
+    jax/jaxlib versions, backend, device count."""
+    import dataclasses
+    import json
+
+    import jax
+
+    from blockchain_simulator_tpu.utils import obs
+
+    avals = [
+        f"{getattr(a, 'shape', None)}:{getattr(a, 'dtype', None)}"
+        for a in jax.tree.leaves(example_args)
+    ]
+    blob = json.dumps(
+        {
+            "format": _DISK_FORMAT,
+            "name": name,
+            "cfg": obs.config_hash(cfg) if dataclasses.is_dataclass(cfg) else str(cfg),
+            "avals": avals,
+            "extra": repr(extra),
+            "jax": _dist_version("jax"),
+            "jaxlib": _dist_version("jaxlib"),
+            "backend": jax.default_backend(),
+            "n_devices": len(jax.devices()),
+        },
+        sort_keys=True,
+    )
+    return hashlib.sha256(blob.encode()).hexdigest()[:24]
+
+
+def _model_modules(cfg) -> None:
+    """Import the model modules whose flax-struct pytree types appear in a
+    serialized executable's in/out treedefs — unpickling a treedef resolves
+    them by type, so they must be importable first."""
+    from blockchain_simulator_tpu.models.base import get_protocol
+
+    proto = getattr(cfg, "protocol", None)
+    if proto is None:
+        return
+    get_protocol(proto)
+    if proto == "pbft":
+        from blockchain_simulator_tpu.models import pbft_round  # noqa: F401
+    elif proto in ("raft", "mixed"):
+        from blockchain_simulator_tpu.models import raft_hb  # noqa: F401
+
+
+def aot_compile(name: str, jitted, example_args: tuple, cfg=None, extra=None):
+    """AOT-stage ``jitted`` for ``example_args``: returns ``(compiled,
+    info)`` where ``info`` = ``{"source": "disk"|"compile",
+    "compile_s": float, "cost": {"flops", "bytes"} | None}``.
+
+    With ``$BLOCKSIM_COMPILE_CACHE`` set, tries
+    ``jax.experimental.serialize_executable`` round-trips first (load) and
+    last (save); any disk-layer failure degrades to a fresh compile and a
+    counter bump, never an exception.  The in-process :data:`registry` is
+    the first-level cache — wrap call sites in :func:`cached_factory` (or
+    call :func:`aot_cached`) so repeat invocations skip this entirely.
+    """
+    import jax
+
+    info: dict = {"source": "compile", "compile_s": None, "cost": None}
+    pdir = persistent_dir()
+    path = None
+    if pdir:
+        try:
+            os.makedirs(pdir, exist_ok=True)
+            path = os.path.join(
+                pdir, f"{name}-{_disk_key(name, cfg, example_args, extra)}.jaxexe"
+            )
+        except Exception:
+            registry.disk_errors += 1
+            path = None
+    t0 = time.perf_counter()
+    if path and os.path.exists(path):
+        try:
+            from jax.experimental.serialize_executable import (
+                deserialize_and_load,
+            )
+
+            if cfg is not None:
+                _model_modules(cfg)
+            with open(path, "rb") as f:
+                fmt, payload, in_tree, out_tree = pickle.load(f)
+            if fmt != _DISK_FORMAT:
+                raise ValueError(f"stale cache format {fmt}")
+            compiled = deserialize_and_load(payload, in_tree, out_tree)
+            registry.disk_hits += 1
+            info["source"] = "disk"
+            info["compile_s"] = time.perf_counter() - t0
+            info["cost"] = _cost(compiled)
+            return compiled, info
+        except Exception:
+            # corrupt/stale/foreign entry: recompile (and overwrite below)
+            registry.disk_errors += 1
+    elif path:
+        registry.disk_misses += 1
+    compiled = jitted.lower(*example_args).compile()
+    info["compile_s"] = time.perf_counter() - t0
+    info["cost"] = _cost(compiled)
+    if path:
+        try:
+            from jax.experimental.serialize_executable import serialize
+
+            payload, in_tree, out_tree = serialize(compiled)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                pickle.dump((_DISK_FORMAT, payload, in_tree, out_tree), f)
+            os.replace(tmp, path)  # atomic: readers never see a torn entry
+            registry.disk_saves += 1
+        except Exception:
+            registry.disk_errors += 1
+    return compiled, info
+
+
+def _cost(compiled) -> dict | None:
+    """XLA's own {flops, bytes accessed} of a compiled executable (the
+    roofline fields bench.py puts on its artifact), or None."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        return {
+            "flops": float(ca.get("flops", 0.0)),
+            "bytes": float(ca.get("bytes accessed", 0.0)),
+        }
+    except Exception:
+        return None
+
+
+def aot_cached(name: str, jitted_factory, example_args: tuple, cfg=None, extra=None):
+    """Registry-memoized :func:`aot_compile`: one entry per (name, cfg,
+    extra, input avals).  ``jitted_factory()`` is only called on a miss.
+    Returns ``(compiled, info)`` — ``info`` is the build-time record (a
+    registry hit returns the original record with ``source`` unchanged and
+    ``compile_s`` as paid at build time)."""
+    import jax
+
+    shapes = tuple(
+        (str(getattr(a, "shape", None)), str(getattr(a, "dtype", None)))
+        for a in jax.tree.leaves(example_args)
+    )
+    return registry.get(
+        f"aot:{name}",
+        (cfg, extra, shapes),
+        {},
+        lambda *_a, **_k: aot_compile(
+            name, jitted_factory(), example_args, cfg=cfg, extra=extra
+        ),
+    )

@@ -99,6 +99,17 @@ def manifest(cfg=None, backend=None, device_count=None) -> dict:
         rec["backend"] = backend
     if device_count is not None:
         rec["device_count"] = device_count
+    try:
+        # executable-registry provenance (utils/aotcache.py): hit/miss
+        # counters, the last registry key touched, and the persistent cache
+        # dir (null when disabled).  aotcache never imports jax at module
+        # scope and .manifest() only reads counters, so this is safe from
+        # the bench parent's no-jax path too.
+        from blockchain_simulator_tpu.utils import aotcache
+
+        rec["cache"] = aotcache.registry.manifest()
+    except Exception:  # provenance, never a failure mode
+        pass
     return rec
 
 

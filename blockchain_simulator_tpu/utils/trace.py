@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from blockchain_simulator_tpu.models.base import get_protocol
+from blockchain_simulator_tpu.utils import aotcache
 from blockchain_simulator_tpu.utils import prng
 from blockchain_simulator_tpu.utils.config import SimConfig
 from blockchain_simulator_tpu.utils.sync import force_sync
@@ -86,11 +87,13 @@ def _np_series(ys) -> dict:
     return {k: np.asarray(v) for k, v in ys.items()}
 
 
-# The jitted programs are cached per config (SimConfig is frozen/hashable,
-# the same convention as runner.make_sim_fn) so a multi-seed --trace sweep
-# compiles once and reruns with fresh keys.
+# The jitted programs are cached per config in the unified executable
+# registry (utils/aotcache.py; SimConfig is frozen/hashable, the same
+# convention as runner.make_sim_fn) so a multi-seed --trace sweep compiles
+# once and reruns with fresh keys — and the hit/miss trail lands on the run
+# manifest's `cache` block.
 
-@functools.lru_cache(maxsize=32)
+@aotcache.cached_factory("trace-tick")
 def _tick_traced_fn(cfg: SimConfig):
     proto = get_protocol(cfg.protocol)
 
@@ -118,7 +121,7 @@ def _traced_tick(cfg: SimConfig, seed):
     return proto.metrics(cfg, state), _np_series(ys)
 
 
-@functools.lru_cache(maxsize=32)
+@aotcache.cached_factory("trace-pbft-round")
 def _pbft_round_traced_fn(cfg: SimConfig):
     from blockchain_simulator_tpu.models import pbft_round
 
@@ -147,7 +150,7 @@ def _traced_pbft_round(cfg: SimConfig, seed):
     return pbft_round.metrics(cfg, state), series
 
 
-@functools.lru_cache(maxsize=32)
+@aotcache.cached_factory("trace-raft-hb")
 def _raft_hb_traced_fns(cfg: SimConfig):
     """(prefix, steady, cont) jitted programs for the traced raft fast path;
     the key is a runtime argument so seeds share one compile."""
@@ -218,7 +221,7 @@ def _traced_raft_hb(cfg: SimConfig, seed):
     return raft_hb.metrics(cfg, state), series
 
 
-@functools.lru_cache(maxsize=32)
+@aotcache.cached_factory("trace-mixed")
 def _mixed_traced_fns(cfg: SimConfig):
     """(prefix, finish, prefix_probed, cont) jitted programs for the traced
     mixed fast path; the key is a runtime argument so seeds share one

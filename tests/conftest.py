@@ -24,3 +24,13 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 assert jax.default_backend() == "cpu"
+
+
+def pytest_configure(config):
+    # the tier-1 command deselects these with -m 'not slow' (ROADMAP.md);
+    # registering the marker keeps that filter warning-free
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy end-to-end tests (bench subprocess pairs) excluded "
+        "from the tier-1 870 s window via -m 'not slow'",
+    )

@@ -55,6 +55,7 @@ def load_bench_file(path: str) -> dict:
         row["backend"] = parsed.get("backend")
         row["rounds"] = parsed.get("rounds")
         row["wall_s"] = parsed.get("wall_s")
+        row["compile_s"] = parsed.get("compile_s")
     return row
 
 
@@ -97,6 +98,8 @@ def load_runs_jsonl(path: str) -> list[dict]:
                 "backend": rec.get("backend") or man.get("backend"),
                 "rounds": rec.get("rounds"),
                 "wall_s": rec.get("wall_s"),
+                "compile_s": rec.get("compile_s",
+                                     man.get("compile_plus_first_run_s")),
             })
     return rows
 
@@ -115,8 +118,22 @@ def trajectory(rows: list[dict]) -> dict[str, list[dict]]:
 # charted but never gated here: the drop-means-regression rule below is for
 # throughput metrics, and a findings INCREASE already fails the lint gate's
 # own exit code — applying the throughput rule would flag *fixing* findings
-# as a regression.
-UNGATED_SUFFIXES = ("_findings",)
+# as a regression.  Same carve-out for compile_s trajectories: dropping
+# compile wall (warm persistent-cache runs, utils/aotcache.py) is the GOAL,
+# and the throughput rule would read it as a 10x regression.
+UNGATED_SUFFIXES = ("_findings", "_compile_s")
+
+
+def compile_s_rows(rows: list[dict]) -> list[dict]:
+    """Derived lower-is-better trajectory: one ``<metric>_compile_s`` row per
+    result row that measured its compile stage (bench.py attempts, manifest
+    ``compile_plus_first_run_s``).  Charted next to the throughput history,
+    excluded from the regression gate by suffix."""
+    return [
+        dict(r, metric=f"{r['metric']}_compile_s", value=r["compile_s"])
+        for r in rows
+        if r.get("metric") and isinstance(r.get("compile_s"), (int, float))
+    ]
 
 
 def check_regressions(by_metric: dict, threshold: float) -> list[str]:
@@ -161,6 +178,7 @@ def main(argv=None) -> int:
     rows.sort(key=lambda r: (r["round"] is None, r["round"]))
     if args.runs:
         rows.extend(load_runs_jsonl(args.runs))
+    rows.extend(compile_s_rows(rows))
 
     by_metric = trajectory(rows)
     for metric, mrows in sorted(by_metric.items()):

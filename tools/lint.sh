@@ -11,6 +11,10 @@
 # (one line, metric "jaxlint_new_findings") via utils/obs.py, so the findings
 # trajectory is charted by bench_compare next to the perf history.
 #
+# After both gates, tools/warm_bench.sh measures the cold-vs-warm compile
+# split of the CPU fallback bench against a persistent compile cache
+# (WARM_BENCH=0 skips; see the block below).
+#
 # Usage: tools/lint.sh [--threshold 0.5]
 set -u
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
@@ -37,6 +41,25 @@ bench_rc=$?
 if [ "$bench_rc" -ne 0 ]; then
     echo "lint.sh: bench_compare FAILED (rc=$bench_rc)" >&2
     rc=1
+fi
+
+# Cold-vs-warm compile check (tools/warm_bench.sh): the CPU fallback bench
+# twice against one persistent compile cache; fails when the warm run's
+# compile_s does not improve.  Scaled down here (2000 nodes, 200 rounds —
+# ~1 min on the 2-core box) so the gate stays cheap; WARM_BENCH=0 skips
+# (the test-suite smoke does), and the full-scale artifact run is
+# `bash tools/warm_bench.sh` with its 10k defaults.
+if [ "${WARM_BENCH:-1}" != "0" ]; then
+    echo "== warm_bench =="
+    WARM_BENCH_N="${WARM_BENCH_N:-2000}" \
+    WARM_BENCH_ROUNDS="${WARM_BENCH_ROUNDS:-200}" \
+    WARM_BENCH_OUT="${WARM_BENCH_OUT:-$(mktemp /tmp/warm_bench.XXXXXX.json)}" \
+        bash tools/warm_bench.sh
+    warm_rc=$?
+    if [ "$warm_rc" -ne 0 ]; then
+        echo "lint.sh: warm_bench FAILED (rc=$warm_rc)" >&2
+        rc=1
+    fi
 fi
 
 exit $rc

@@ -1,0 +1,114 @@
+"""Repro: XLA:CPU executable serialization on this container (jax 0.4.37).
+
+KNOWN_ISSUES.md #0e records the measured verdict this script produces: does
+``jax.experimental.serialize_executable`` round-trip a compiled simulation
+executable across PROCESSES on the XLA:CPU backend, bit-equal, and how much
+compile wall does the deserialize path save?  The persistent layer of
+``utils/aotcache.py`` is gated on exactly this capability — if a jax upgrade
+breaks it, this script is the 60-second check (aotcache degrades to
+in-process-only caching either way; it never raises).
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/repro_exe_serialize.py
+
+Runs itself twice: the parent compiles + serializes + measures, then
+re-execs as a child that deserializes + runs + compares metrics.  Prints one
+JSON verdict line: {"serialize_ok", "bit_equal", "compile_s", "deserialize_s",
+"payload_bytes"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+import time
+
+CFG_KW = dict(protocol="pbft", n=64, sim_ms=2000, delivery="stat")
+SEED = 7
+
+
+def _metrics(final):
+    from blockchain_simulator_tpu.models.base import get_protocol
+    from blockchain_simulator_tpu.utils.config import SimConfig
+
+    return get_protocol("pbft").metrics(SimConfig(**CFG_KW), final)
+
+
+def child(path: str) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    # treedef unpickling resolves flax-struct state types by import
+    from blockchain_simulator_tpu.models import pbft  # noqa: F401
+    from jax.experimental.serialize_executable import deserialize_and_load
+
+    with open(path, "rb") as f:
+        payload, in_tree, out_tree = pickle.load(f)
+    t0 = time.perf_counter()
+    compiled = deserialize_and_load(payload, in_tree, out_tree)
+    dt = time.perf_counter() - t0
+    final = jax.block_until_ready(compiled(jax.random.key(SEED)))
+    print(json.dumps({"deserialize_s": round(dt, 3), "metrics": _metrics(final)},
+                     default=str))
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from blockchain_simulator_tpu.runner import make_sim_fn
+    from blockchain_simulator_tpu.utils.config import SimConfig
+
+    sim = make_sim_fn(SimConfig(**CFG_KW))
+    key = jax.random.key(SEED)
+    t0 = time.perf_counter()
+    compiled = sim.lower(key).compile()
+    compile_s = time.perf_counter() - t0
+    ref = _metrics(jax.block_until_ready(compiled(key)))
+
+    verdict = {"serialize_ok": False, "bit_equal": None,
+               "compile_s": round(compile_s, 3), "deserialize_s": None,
+               "payload_bytes": None}
+    path = None
+    try:
+        from jax.experimental.serialize_executable import serialize
+
+        payload, in_tree, out_tree = serialize(compiled)
+        verdict["payload_bytes"] = len(payload)
+        fd, path = tempfile.mkstemp(suffix=".jaxexe")
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump((payload, in_tree, out_tree), f)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", path],
+            capture_output=True, text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(proc.stderr[-1000:])
+        child_rec = json.loads(proc.stdout.strip().splitlines()[-1])
+        verdict["serialize_ok"] = True
+        verdict["deserialize_s"] = child_rec["deserialize_s"]
+        verdict["bit_equal"] = all(
+            str(child_rec["metrics"][k]) == str(v) for k, v in ref.items()
+        )
+    except Exception as e:  # the verdict line IS the point — never traceback
+        verdict["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        if path:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    print(json.dumps(verdict))
+    return 0 if verdict["serialize_ok"] and verdict["bit_equal"] else 1
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        child(sys.argv[sys.argv.index("--child") + 1])
+    else:
+        sys.exit(main())

@@ -1,0 +1,124 @@
+"""ARTIFACT_sweep_cache.json generator: compile-once f-sweep vs per-f compiles.
+
+The acceptance measurement of the compile-amortization layer
+(utils/aotcache.py + runner.make_dyn_sim_fn + parallel/sweep.py): a
+Byzantine f-sweep over >= 8 fault levels with fixed seeds must
+
+- compile exactly ONE executable (asserted from the registry's miss count
+  around the sweep), and
+- beat the old one-compile-per-f baseline by >= 5x on end-to-end wall,
+  compile included.
+
+The baseline phase reproduces the pre-refactor behavior faithfully: one
+static-fault-config batched program per f level (``run_seed_sweep`` on
+``cfg.with_(faults=...)`` — exactly what ``run_fault_sweep`` used to loop
+over), each paying its own trace+lower+XLA.  Both phases run in THIS process
+back to back; the dynamic phase runs first so the baseline cannot warm it.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/sweep_cache_bench.py
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+
+ARTIFACT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "ARTIFACT_sweep_cache.json",
+)
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from blockchain_simulator_tpu.parallel.sweep import (
+        run_byzantine_sweep,
+        run_seed_sweep,
+    )
+    from blockchain_simulator_tpu.utils import aotcache
+    from blockchain_simulator_tpu.utils.config import SimConfig
+
+    # BASELINE config 4 at the 10k fallback scale: passive (vote-flipping)
+    # Byzantine sweep on the round-blocked fast path — the workload where
+    # compile amortization pays hardest (XLA compile per point against
+    # fractions of a second of simulation; forge mode targets the
+    # exact-window tick machine and is measured by the tick-engine pins in
+    # tests/test_zsweep_cache.py instead).  11 fault levels up to n/3, one
+    # fixed seed.  stat_sampler pinned to "exact": the integer BTRS draws
+    # are bit-stable across differently-compiled programs, whereas the
+    # "normal" CLT sampler's float path can shift one message across
+    # adjacent delay buckets between the dynamic and static executables
+    # (same keys — measured: one slot's commit tail moved 1 tick at
+    # f=2331), the same ±1-tick jitter class the fast paths document
+    # against the tick engine.
+    cfg = SimConfig(
+        protocol="pbft", n=10_000, sim_ms=600, delivery="stat",
+        model_serialization=False, pbft_window=8, pbft_max_slots=48,
+        stat_sampler="exact",
+    )
+    f_values = list(range(0, 3333, 333))
+    seeds = (0,)
+    forge = False
+
+    # ---- dynamic-operand sweep: ONE executable over (f, seed) --------------
+    s0 = aotcache.registry.stats()
+    t0 = time.perf_counter()
+    rows = run_byzantine_sweep(cfg, f_values=f_values, seeds=seeds, forge=forge)
+    dyn_wall = time.perf_counter() - t0
+    s1 = aotcache.registry.stats()
+    dyn_executables = s1["misses"] - s0["misses"]
+
+    # ---- per-f static baseline: the pre-refactor loop ----------------------
+    t0 = time.perf_counter()
+    static_rows = []
+    for f in f_values:
+        fc = dataclasses.replace(cfg.faults, n_byzantine=f, byz_forge=forge)
+        for seed, m in zip(seeds, run_seed_sweep(cfg.with_(faults=fc),
+                                                 seeds=list(seeds))):
+            static_rows.append({"f": int(f), "seed": int(seed), **m})
+    static_wall = time.perf_counter() - t0
+    s2 = aotcache.registry.stats()
+
+    bit_equal = all(
+        {k: str(v) for k, v in d.items()} == {k: str(v) for k, v in s.items()}
+        for d, s in zip(rows, static_rows)
+    )
+    speedup = static_wall / dyn_wall if dyn_wall > 0 else None
+    rec = {
+        "metric": "byz_sweep_e2e_wall_s",
+        "config": {"protocol": cfg.protocol, "n": cfg.n, "sim_ms": cfg.sim_ms,
+                   "delivery": cfg.delivery, "schedule": cfg.schedule,
+                   "f_levels": len(f_values), "seeds": list(seeds)},
+        "dynamic": {
+            "wall_s": round(dyn_wall, 2),
+            "executables_compiled": dyn_executables,
+            "rows": len(rows),
+        },
+        "static_baseline": {
+            "wall_s": round(static_wall, 2),
+            "registry_misses": s2["misses"] - s1["misses"],
+        },
+        "speedup_e2e": round(speedup, 2) if speedup else None,
+        "rows_bit_equal": bit_equal,
+        "registry": s2,
+    }
+    with open(ARTIFACT, "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    print(json.dumps(rec))
+    ok = dyn_executables == 1 and bit_equal and speedup and speedup >= 5.0
+    if not ok:
+        print(f"sweep_cache_bench: ACCEPTANCE NOT MET (executables="
+              f"{dyn_executables}, bit_equal={bit_equal}, "
+              f"speedup={speedup:.2f})")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# Cold-vs-warm compile measurement: run the CPU fallback bench TWICE against
+# one persistent compile cache (utils/aotcache.py: serialized executables in
+# $BLOCKSIM_COMPILE_CACHE + jax's own compilation cache in
+# $BLOCKSIM_XLA_CACHE) and emit ARTIFACT_warm_bench.json recording both
+# compile_s values and the warm speedup.  The second run should report
+# near-zero compile_s: its executable deserializes from disk instead of
+# re-tracing + re-running XLA (measured working on this container's
+# jax 0.4.37 / XLA:CPU — KNOWN_ISSUES.md #0e).
+#
+# Chained after the lint + bench_compare gates by tools/lint.sh (skip with
+# WARM_BENCH=0).  Env knobs:
+#   WARM_BENCH_N       cluster size        (default 10000 — the fallback bench)
+#   WARM_BENCH_ROUNDS  consensus rounds    (default 2000)
+#   WARM_BENCH_OUT     artifact path       (default ARTIFACT_warm_bench.json)
+#   BLOCKSIM_COMPILE_CACHE / BLOCKSIM_XLA_CACHE
+#                      cache dirs (default: fresh temp dir -> a true cold run)
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+
+N="${WARM_BENCH_N:-10000}"
+ROUNDS="${WARM_BENCH_ROUNDS:-2000}"
+OUT="${WARM_BENCH_OUT:-$REPO/ARTIFACT_warm_bench.json}"
+CACHE="${BLOCKSIM_COMPILE_CACHE:-$(mktemp -d /tmp/blocksim_exe_cache.XXXXXX)}"
+XCACHE="${BLOCKSIM_XLA_CACHE:-$CACHE/xla}"
+mkdir -p "$CACHE" "$XCACHE"
+
+run_bench() {
+    # JAX_PLATFORMS=cpu + PALLAS_AXON_POOL_IPS= : the first bench child IS
+    # the CPU fallback (no TPU-tunnel plugin registration, bench.py notes);
+    # single attempt (no ladder, no companion) so each run pays exactly one
+    # compile stage and the cold/warm comparison is one executable's story.
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    BENCH_N="$N" BENCH_ROUNDS="$ROUNDS" \
+    BENCH_ROUNDS_FIRST=0 BENCH_ROUNDS_SER=0 \
+    BLOCKSIM_COMPILE_CACHE="$CACHE" BLOCKSIM_XLA_CACHE="$XCACHE" \
+    python bench.py
+}
+
+echo "warm_bench: cold run (N=$N, rounds=$ROUNDS, cache=$CACHE)" >&2
+cold_line="$(run_bench)" || { echo "warm_bench: cold run failed" >&2; exit 1; }
+echo "warm_bench: warm run" >&2
+warm_line="$(run_bench)" || { echo "warm_bench: warm run failed" >&2; exit 1; }
+
+COLD="$cold_line" WARM="$warm_line" N="$N" ROUNDS="$ROUNDS" CACHE="$CACHE" \
+OUT="$OUT" python - <<'EOF'
+import json
+import os
+
+cold = json.loads(os.environ["COLD"].strip().splitlines()[-1])
+warm = json.loads(os.environ["WARM"].strip().splitlines()[-1])
+cs, ws = cold.get("compile_s"), warm.get("compile_s")
+rec = {
+    "metric": "warm_bench_compile_s",
+    "n": int(os.environ["N"]),
+    "rounds": int(os.environ["ROUNDS"]),
+    "cache_dir": os.environ["CACHE"],
+    "cold": {k: cold.get(k) for k in
+             ("metric", "value", "compile_s", "wall_s", "backend")},
+    "warm": {k: warm.get(k) for k in
+             ("metric", "value", "compile_s", "wall_s", "backend")},
+    "compile_speedup_warm": (round(cs / ws, 1) if cs and ws else None),
+}
+with open(os.environ["OUT"], "w") as f:
+    json.dump(rec, f, indent=1)
+    f.write("\n")
+print(json.dumps(rec))
+ok = cs is not None and ws is not None and ws < cs
+raise SystemExit(0 if ok else 1)
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "warm_bench: warm compile_s did not improve on cold (see $OUT)" >&2
+fi
+exit "$rc"

@@ -82,12 +82,18 @@ def parse_suppressions(src: str) -> dict[int, set[str]]:
 
 
 def lint_source(
-    src: str, path: str = "<memory>", rules=None
+    src: str, path: str = "<memory>", rules=None, stale_sup_out=None
 ) -> tuple[list[common.Finding], int]:
     """Lint one source blob; returns (findings, n_suppressed).
 
     Raises ``SyntaxError`` for unparseable source — callers decide whether
     that is exit-2 (CLI) or a test failure (fixtures).
+
+    ``stale_sup_out`` (a list) collects ``(path, line, rule)`` for inline
+    ``# jaxlint: disable=`` directives that suppressed nothing — dead
+    suppressions that would silently swallow a future real finding.  Only
+    populated on full-rule runs (``rules=None``): a subset run cannot decide
+    that a directive for an un-run rule is dead.
     """
     tree = ast.parse(src)
     common.annotate_parents(tree)
@@ -106,6 +112,7 @@ def lint_source(
     sup = parse_suppressions(src)
     kept: list[common.Finding] = []
     n_suppressed = 0
+    used: set[tuple[int, str]] = set()
     for f in findings:
         span = range(f.line, (f.end_line or f.line) + 1)
         directives: set[str] = set()
@@ -113,8 +120,17 @@ def lint_source(
             directives |= sup.get(ln, set())
         if f.rule in directives or "all" in directives:
             n_suppressed += 1
+            match = f.rule if f.rule in directives else "all"
+            for ln in span:
+                if match in sup.get(ln, set()):
+                    used.add((ln, match))
         else:
             kept.append(f)
+    if stale_sup_out is not None and rules is None:
+        for ln in sorted(sup):
+            for rule_id in sorted(sup[ln]):
+                if (ln, rule_id) not in used:
+                    stale_sup_out.append((path, ln, rule_id))
     kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return kept, n_suppressed
 
@@ -160,12 +176,14 @@ def iter_py_files(paths: list[str]):
 
 
 def lint_paths(
-    paths: list[str], rules=None
+    paths: list[str], rules=None, stale_sup_out=None
 ) -> tuple[list[common.Finding], dict[str, list[str]], int, list[str]]:
     """Lint every file under ``paths``; returns
     (findings, {linted_rel_path: src_lines}, n_suppressed, parse_errors).
     The returned sources are THE text the findings were computed against —
-    baseline keying reuses them instead of re-reading from disk."""
+    baseline keying reuses them instead of re-reading from disk.
+    ``stale_sup_out`` aggregates dead inline suppressions per
+    :func:`lint_source`."""
     findings: list[common.Finding] = []
     files: dict[str, list[str]] = {}
     n_suppressed = 0
@@ -182,7 +200,8 @@ def lint_paths(
             continue
         files[rp] = src.splitlines()
         try:
-            fs, ns = lint_source(src, path=rp, rules=rules)
+            fs, ns = lint_source(src, path=rp, rules=rules,
+                                 stale_sup_out=stale_sup_out)
         except SyntaxError as e:
             errors.append(f"{fp}: syntax error: {e}")
             continue
@@ -210,9 +229,12 @@ def split_by_baseline(
     findings: list[common.Finding],
     baseline: dict[tuple[str, str, str], dict],
     line_text_of,
+    used_out: Counter | None = None,
 ) -> tuple[list[common.Finding], int, list[tuple[str, str, str]]]:
-    """(new findings, n_baselined, stale baseline keys)."""
-    used: Counter = Counter()
+    """(new findings, n_baselined, stale baseline keys).  ``used_out``
+    receives the per-key consumed counts (``--prune-baseline`` rewrites
+    entries down to exactly these)."""
+    used: Counter = used_out if used_out is not None else Counter()
     new: list[common.Finding] = []
     for f in findings:
         key = f.key(line_text_of(f))
@@ -276,6 +298,66 @@ def write_baseline(
         f.write("\n")
 
 
+def prune_baseline(
+    path: str,
+    findings: list[common.Finding],
+    line_text_of,
+    old: dict[tuple[str, str, str], dict],
+    linted_paths,
+) -> tuple[list[tuple[str, str, str]], int]:
+    """Baseline hygiene (``--prune-baseline``): rewrite the baseline with
+    each in-scope entry's count reduced to what actually still fires —
+    justifications preserved, fully-fixed entries dropped.  Entries for
+    files outside ``linted_paths`` are preserved wholesale (the
+    ``write_baseline`` subset contract).  Returns (dropped keys,
+    n_reduced)."""
+    used: Counter = Counter()
+    split_by_baseline(findings, old, line_text_of, used_out=used)
+    in_scope = set(linted_paths)
+    counts: Counter = Counter()
+    dropped: list[tuple[str, str, str]] = []
+    n_reduced = 0
+    for key, entry in old.items():
+        if key[1] not in in_scope:
+            # entries for files that no longer exist ARE decidable — a
+            # deleted/renamed file's entry is exactly the staleness this
+            # command exists to clean (the write_baseline contract)
+            fp = key[1] if os.path.isabs(key[1]) \
+                else os.path.join(REPO_ROOT, key[1])
+            if os.path.exists(fp):
+                counts[key] = entry["count"]  # not linted: not decidable
+            else:
+                dropped.append(key)
+            continue
+        still = used[key]
+        if still == 0:
+            dropped.append(key)
+        else:
+            if still < entry["count"]:
+                n_reduced += 1
+            counts[key] = still
+    entries = []
+    for (rule, fpath, text), count in sorted(counts.items()):
+        entries.append({
+            "rule": rule, "path": fpath, "text": text, "count": count,
+            "justification": old[(rule, fpath, text)]["justification"],
+        })
+    doc = {
+        "jaxlint_baseline": 1,
+        "comment": (
+            "Grandfathered findings: (rule, path, stripped source line) -> "
+            "count + one-line justification.  Regenerate with `python -m "
+            "blockchain_simulator_tpu.lint --write-baseline` (existing "
+            "justifications are preserved); new code must come in clean."
+        ),
+        "entries": entries,
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    return dropped, n_reduced
+
+
 # --------------------------------------------------------------------- CLI
 
 def _default_paths() -> list[str]:
@@ -329,6 +411,10 @@ def main(argv=None) -> int:
     p.add_argument("--write-baseline", action="store_true",
                    help="write the current findings as the new baseline "
                         "(preserves existing justifications) and exit 0")
+    p.add_argument("--prune-baseline", action="store_true",
+                   help="baseline hygiene: drop/shrink baseline entries "
+                        "that no longer fire (justifications preserved), "
+                        "report dead inline suppressions, and exit 0")
     p.add_argument("--list-rules", action="store_true")
     args = p.parse_args(argv)
 
@@ -339,8 +425,11 @@ def main(argv=None) -> int:
 
     paths = resolve_path_args(args.paths) if args.paths \
         else _default_paths()
+    stale_sups: list[tuple[str, int, str]] = []
     try:
-        findings, files, n_suppressed, errors = lint_paths(paths)
+        findings, files, n_suppressed, errors = lint_paths(
+            paths, stale_sup_out=stale_sups
+        )
     except FileNotFoundError as e:
         print(f"jaxlint: {e}", file=sys.stderr)
         return 2
@@ -358,6 +447,30 @@ def main(argv=None) -> int:
         write_baseline(baseline_path, findings, line_text_of, old,
                        linted_paths=files)
         print(f"jaxlint: wrote {len(findings)} finding(s) to "
+              f"{baseline_path}")
+        return 0
+
+    if args.prune_baseline:
+        if not os.path.exists(baseline_path):
+            print(f"jaxlint: no baseline at {baseline_path}",
+                  file=sys.stderr)
+            return 2
+        try:
+            old = load_baseline(baseline_path)
+        except (json.JSONDecodeError, KeyError, TypeError) as e:
+            print(f"jaxlint: bad baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+        dropped, n_reduced = prune_baseline(
+            baseline_path, findings, line_text_of, old, linted_paths=files
+        )
+        for rule, fpath, text in dropped:
+            print(f"jaxlint: pruned fixed entry {rule} @ {fpath}: {text!r}")
+        for fpath, ln, rule in stale_sups:
+            print(f"jaxlint: stale suppression {fpath}:{ln}: "
+                  f"`# jaxlint: disable={rule}` no longer fires — remove it")
+        print(f"jaxlint: pruned {len(dropped)} entr(ies), reduced "
+              f"{n_reduced}, {len(stale_sups)} stale suppression(s) in "
               f"{baseline_path}")
         return 0
 
@@ -386,6 +499,10 @@ def main(argv=None) -> int:
             "stale_baseline": [
                 {"rule": r, "path": pp, "text": t} for r, pp, t in stale
             ],
+            "stale_suppressions": [
+                {"path": pp, "line": ln, "rule": r}
+                for pp, ln, r in stale_sups
+            ],
             "rules": sorted(RULES_BY_ID),
         }, indent=1))
     else:
@@ -396,6 +513,11 @@ def main(argv=None) -> int:
         for r, pp, t in stale:
             print(f"jaxlint: stale baseline entry {r} @ {pp}: {t!r} "
                   "(fixed? regenerate with --write-baseline)",
+                  file=sys.stderr)
+        for pp, ln, r in stale_sups:
+            print(f"jaxlint: stale suppression {pp}:{ln}: "
+                  f"`# jaxlint: disable={r}` no longer fires "
+                  "(remove it, or --prune-baseline for a report)",
                   file=sys.stderr)
         print(f"jaxlint: {len(files)} files, {len(new)} new finding(s), "
               f"{n_baselined} baselined, {n_suppressed} suppressed")

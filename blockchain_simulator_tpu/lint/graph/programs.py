@@ -1,0 +1,378 @@
+"""The audit surface: every registered executable factory, as traceable specs.
+
+Two halves keep each other honest:
+
+- :func:`discover_factories` finds every ``@aotcache.cached_factory("name")``
+  registration in the source tree by AST (reusing the jaxlint alias
+  machinery — the same no-import contract: discovery must not trigger what
+  it polices);
+- :func:`build_catalog` constructs one or more :class:`ProgramSpec` per
+  factory name — tiny audit-scale configs (n=8, a few hundred ticks) chosen
+  so every engine arm the factory can dispatch to gets traced: tick engines
+  for all four protocols, the round/heartbeat fast paths, the vmapped sweep
+  programs (static and dynamic-fault-operand), the shard_map wrappers, and
+  the probe-traced variants.
+
+A factory name discovered in source with no covering spec is an
+``unaudited-factory`` finding (lint/graph/audit.py), so growing a new
+factory without growing its audit fails the gate — the completeness
+analog of jaxlint's whole-repo sweep.
+
+Specs are traced at aval level only (``jax.eval_shape`` for states,
+``ShapeDtypeStruct`` keys): building the catalog never runs a simulation.
+Configs deliberately pin ``stat_sampler="exact"`` where sampling appears so
+the traced IR is identical across the jax float-path variations the normal
+CLT sampler is allowed (parallel/sweep.py bit-equality caveat).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Callable
+
+from blockchain_simulator_tpu.lint import common
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+# cached_factory resolutions the discovery matcher accepts (the same set the
+# AST static-arg-recompile-hazard rule sanctions).
+_FACTORY_CALLS = frozenset({
+    "aotcache.cached_factory",
+    "blockchain_simulator_tpu.utils.aotcache.cached_factory",
+    "utils.aotcache.cached_factory",
+    "cached_factory",
+})
+
+
+def discover_factories(paths: list[str] | None = None) -> dict[str, list[str]]:
+    """{factory name: [repo-relative files registering it]} over ``paths``
+    (default: the package tree).  Pure AST — nothing is imported."""
+    if paths is None:
+        paths = [os.path.join(REPO_ROOT, "blockchain_simulator_tpu")]
+    found: dict[str, list[str]] = {}
+    for root in paths:
+        files = []
+        if os.path.isfile(root):
+            files = [root]
+        else:
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d != "__pycache__" and not d.startswith(".")
+                )
+                files.extend(
+                    os.path.join(dirpath, fn)
+                    for fn in sorted(filenames) if fn.endswith(".py")
+                )
+        for fp in files:
+            try:
+                with open(fp, encoding="utf-8") as f:
+                    tree = ast.parse(f.read())
+            except (OSError, SyntaxError):
+                continue
+            aliases = common.import_aliases(tree)
+            rel = os.path.relpath(fp, REPO_ROOT).replace(os.sep, "/")
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call) and node.args):
+                    continue
+                r = common.resolve(node.func, aliases)
+                if r not in _FACTORY_CALLS:
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    found.setdefault(arg.value, [])
+                    if rel not in found[arg.value]:
+                        found[arg.value].append(rel)
+    return found
+
+
+@dataclasses.dataclass
+class ProgramSpec:
+    """One traceable program of the audit surface.
+
+    ``build()`` (lazy — first jax touch) returns ``(fn, example_args)``
+    where ``fn`` is jitted or plain and ``example_args`` may be aval-level
+    (``ShapeDtypeStruct`` pytrees).  ``factory`` is the registry name this
+    spec covers; specs sharing a ``divergence_group`` must trace to ONE
+    fingerprint (the registry-key-divergence contract — one key, one
+    executable).  ``budget=False`` skips the FLOP/byte pin (divergence
+    twins re-measure a primary program's graph)."""
+
+    program: str
+    factory: str
+    build: Callable[[], tuple]
+    divergence_group: str | None = None
+    budget: bool = True
+
+
+# ------------------------------------------------------------- aval helpers
+
+def _key_sds():
+    import jax
+
+    return jax.eval_shape(lambda: jax.random.key(0))
+
+
+def _keys_sds(b: int):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.eval_shape(
+        lambda: jax.vmap(jax.random.key)(jnp.arange(b, dtype=jnp.uint32))
+    )
+
+
+def _i32_sds(shape=()):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct(shape, jnp.int32)
+
+
+def _raw(factory_wrapper):
+    """The undecorated factory (``functools.wraps`` sets ``__wrapped__``):
+    audit builds must not populate the process-wide executable registry —
+    registry hit/miss stats land on run manifests, and an audit is not a
+    run."""
+    return getattr(factory_wrapper, "__wrapped__", factory_wrapper)
+
+
+# ------------------------------------------------------------ audit configs
+
+def audit_configs() -> dict[str, "object"]:
+    """The named audit-scale SimConfigs, one per engine arm.  Centralized so
+    tests and the catalog agree on the exact traced surface."""
+    from blockchain_simulator_tpu.utils.config import SimConfig
+
+    return {
+        # tick engines, one per protocol (schedule resolves to 'tick' at n=8)
+        "pbft_tick": SimConfig(protocol="pbft", n=8, sim_ms=200,
+                               stat_sampler="exact"),
+        "raft_tick": SimConfig(protocol="raft", n=8, sim_ms=200,
+                               stat_sampler="exact"),
+        "paxos_tick": SimConfig(protocol="paxos", n=8, sim_ms=200,
+                                stat_sampler="exact"),
+        "mixed_tick": SimConfig(protocol="mixed", n=8, mixed_shards=2,
+                                sim_ms=200, schedule="tick",
+                                stat_sampler="exact"),
+        # fast paths, explicitly scheduled (eligibility asserted in tests)
+        "pbft_round": SimConfig(protocol="pbft", n=8, sim_ms=200,
+                                delivery="stat", schedule="round",
+                                model_serialization=False,
+                                stat_sampler="exact"),
+        "raft_hb": SimConfig(protocol="raft", n=8, sim_ms=400,
+                             delivery="stat", schedule="round",
+                             stat_sampler="exact"),
+        "mixed_fast": SimConfig(protocol="mixed", n=8, mixed_shards=2,
+                                sim_ms=400, delivery="stat",
+                                schedule="round", stat_sampler="exact"),
+    }
+
+
+def _audit_mesh():
+    """A 2-device nodes mesh for the shard_map wrappers (the degenerate
+    sweep axis matches parallel/mesh.make_mesh's layout)."""
+    from blockchain_simulator_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(n_node_shards=2, n_sweep=1)
+
+
+# ---------------------------------------------------------------- catalog
+
+def build_catalog() -> list[ProgramSpec]:
+    """Every audited program.  Lazy throughout: importing this module (or
+    calling this function) touches no backend — each spec's ``build`` does,
+    on first trace."""
+    cfgs = audit_configs()
+    specs: list[ProgramSpec] = []
+
+    # --- runner.make_sim_fn ("sim"): every engine arm -------------------
+    def sim_spec(arm):
+        def build():
+            from blockchain_simulator_tpu import runner
+
+            return _raw(runner.make_sim_fn)(cfgs[arm]), (_key_sds(),)
+
+        return ProgramSpec(f"sim.{arm}", "sim", build)
+
+    for arm in ("pbft_tick", "pbft_round", "raft_tick", "raft_hb",
+                "paxos_tick", "mixed_tick", "mixed_fast"):
+        specs.append(sim_spec(arm))
+
+    # --- runner.make_segment_fn ("segment") -----------------------------
+    def build_segment():
+        import jax
+
+        from blockchain_simulator_tpu import runner
+        from blockchain_simulator_tpu.models.base import get_protocol
+
+        cfg = cfgs["pbft_tick"]
+        proto = get_protocol(cfg.protocol)
+        state, bufs = jax.eval_shape(
+            lambda k: proto.init(cfg, jax.random.fold_in(k, 0x1217)),
+            _key_sds(),
+        )
+        seg = _raw(runner.make_segment_fn)(cfg, 50)
+        return seg, (_key_sds(), state, bufs, _i32_sds())
+
+    specs.append(ProgramSpec("segment.pbft_tick", "segment", build_segment))
+
+    # --- parallel/sweep._batched_fn ("sweep-batched") -------------------
+    def build_batched():
+        from blockchain_simulator_tpu.parallel import sweep
+
+        return _raw(sweep._batched_fn)(cfgs["pbft_tick"], None), (_keys_sds(2),)
+
+    specs.append(ProgramSpec(
+        "sweep_batched.pbft_tick", "sweep-batched", build_batched
+    ))
+
+    # --- parallel/sweep._dyn_batched_fn ("sweep-batched-dynf") ----------
+    # Divergence twins: fault configs that differ only in COUNTS must trace
+    # to ONE jaxpr after canonicalization — otherwise run_fault_sweep's
+    # same-structure grouping silently recompiles per point (the leak the
+    # registry-key-divergence rule exists to catch).
+    def dynf_spec(name, base_arm, fc_kw, group, budget):
+        def build():
+            import dataclasses as _dc
+
+            import jax
+
+            from blockchain_simulator_tpu import runner
+
+            cfg = cfgs[base_arm]
+            cfg = cfg.with_(faults=_dc.replace(cfg.faults, **fc_kw))
+            # make_dyn_sim_fn canonicalizes internally — the twins' traces
+            # must come out identical, which is exactly what the
+            # registry-key-divergence rule asserts.  Per-call jit is fine:
+            # audit builds trace once and never execute.
+            fn = jax.jit(jax.vmap(runner.make_dyn_sim_fn(cfg)))  # jaxlint: disable=static-arg-recompile-hazard
+            return fn, (_keys_sds(2), _i32_sds((2,)), _i32_sds((2,)))
+
+        return ProgramSpec(name, "sweep-batched-dynf", build,
+                           divergence_group=group, budget=budget)
+
+    specs.append(dynf_spec("sweep_dynf.pbft", "pbft_tick",
+                           {"n_byzantine": 1}, "dynf:pbft_tick", True))
+    specs.append(dynf_spec("sweep_dynf.pbft_b2", "pbft_tick",
+                           {"n_byzantine": 2}, "dynf:pbft_tick", False))
+    specs.append(dynf_spec("sweep_dynf.raft", "raft_tick",
+                           {"n_crashed": 1}, "dynf:raft_tick", True))
+    specs.append(dynf_spec("sweep_dynf.raft_c2", "raft_tick",
+                           {"n_crashed": 2}, "dynf:raft_tick", False))
+
+    # --- parallel/shard.py factories ------------------------------------
+    def shard_spec(program, factory, fget, arm):
+        def build():
+            fn = fget()(cfgs[arm], _audit_mesh())
+            return fn, (_key_sds(),)
+
+        return ProgramSpec(program, factory, build)
+
+    def _shard_mod():
+        from blockchain_simulator_tpu.parallel import shard
+
+        return shard
+
+    specs.append(shard_spec(
+        "shard.sim_tick", "shard-sim",
+        lambda: _raw(_shard_mod().make_sharded_sim_fn), "pbft_tick"))
+    specs.append(shard_spec(
+        "shard.pbft_round", "shard-round",
+        lambda: _raw(_shard_mod()._make_sharded_round_fn), "pbft_round"))
+    specs.append(shard_spec(
+        "shard.raft_hb", "shard-raft-hb",
+        lambda: _raw(_shard_mod()._make_sharded_raft_hb_fn), "raft_hb"))
+    specs.append(shard_spec(
+        "shard.mixed_fast", "shard-mixed",
+        lambda: _raw(_shard_mod()._make_sharded_mixed_fast_fn), "mixed_fast"))
+
+    # --- utils/trace.py factories ---------------------------------------
+    def build_trace_tick():
+        from blockchain_simulator_tpu.utils import trace
+
+        return _raw(trace._tick_traced_fn)(cfgs["pbft_tick"]), (_key_sds(),)
+
+    specs.append(ProgramSpec("trace.tick", "trace-tick", build_trace_tick))
+
+    def build_trace_round():
+        from blockchain_simulator_tpu.utils import trace
+
+        return (_raw(trace._pbft_round_traced_fn)(cfgs["pbft_round"]),
+                (_key_sds(),))
+
+    specs.append(ProgramSpec(
+        "trace.pbft_round", "trace-pbft-round", build_trace_round
+    ))
+
+    # The raft_hb / mixed trace factories return several programs (the host
+    # drives the phase split); every one of them is an executable the
+    # registry serves, so every one is audited.  Downstream example args
+    # come from eval_shape chains — still nothing executes.
+    def _raft_hb_fns():
+        from blockchain_simulator_tpu.utils import trace
+
+        return _raw(trace._raft_hb_traced_fns)(cfgs["raft_hb"])
+
+    def build_hb_prefix():
+        return _raft_hb_fns()[0], (_key_sds(),)
+
+    def build_hb_steady():
+        import jax
+
+        prefix, steady, _ = _raft_hb_fns()
+        carry, _ys, _ok, h = jax.eval_shape(prefix, _key_sds())
+        return steady, (carry[0], h, _key_sds())
+
+    def build_hb_cont():
+        import jax
+
+        prefix, _, cont = _raft_hb_fns()
+        carry, _ys, _ok, _h = jax.eval_shape(prefix, _key_sds())
+        return cont, (carry, _key_sds())
+
+    specs.append(ProgramSpec(
+        "trace.raft_hb_prefix", "trace-raft-hb", build_hb_prefix))
+    specs.append(ProgramSpec(
+        "trace.raft_hb_steady", "trace-raft-hb", build_hb_steady))
+    specs.append(ProgramSpec(
+        "trace.raft_hb_cont", "trace-raft-hb", build_hb_cont))
+
+    def _mixed_fns():
+        from blockchain_simulator_tpu.utils import trace
+
+        return _raw(trace._mixed_traced_fns)(cfgs["mixed_fast"])
+
+    def build_mx_prefix():
+        return _mixed_fns()[0], (_key_sds(),)
+
+    def build_mx_finish():
+        import jax
+
+        prefix, finish, _, _ = _mixed_fns()
+        carry, _ok, h_s = jax.eval_shape(prefix, _key_sds())
+        return finish, (carry, h_s, _key_sds())
+
+    def build_mx_prefix_probed():
+        return _mixed_fns()[2], (_key_sds(),)
+
+    def build_mx_cont():
+        import jax
+
+        _, _, prefix_probed, cont = _mixed_fns()
+        carry, _ys = jax.eval_shape(prefix_probed, _key_sds())
+        return cont, (carry, _key_sds())
+
+    specs.append(ProgramSpec(
+        "trace.mixed_prefix", "trace-mixed", build_mx_prefix))
+    specs.append(ProgramSpec(
+        "trace.mixed_finish", "trace-mixed", build_mx_finish))
+    specs.append(ProgramSpec(
+        "trace.mixed_prefix_probed", "trace-mixed", build_mx_prefix_probed))
+    specs.append(ProgramSpec(
+        "trace.mixed_cont", "trace-mixed", build_mx_cont))
+
+    return specs

@@ -41,9 +41,12 @@ TRACING_CALLS = frozenset({
     "jax.experimental.shard_map.shard_map",
 })
 
-# host-sync callables (canonical dotted names)
+# host-sync callables (canonical dotted names).  The whole numpy as*
+# coercion family is here: np.asanyarray/ascontiguousarray force the same
+# device->host materialization np.asarray does (the round-8 audit gap).
 SYNC_CALLS = frozenset({
     "jax.device_get", "numpy.asarray", "numpy.array", "numpy.frombuffer",
+    "numpy.asanyarray", "numpy.ascontiguousarray", "numpy.asfortranarray",
 })
 SYNC_METHODS = frozenset({"item", "tolist"})
 CAST_BUILTINS = frozenset({"float", "int", "bool"})
@@ -216,8 +219,19 @@ def check(ctx: common.RuleContext) -> list[common.Finding]:
                 what = f".{node.func.attr}()"
             elif isinstance(node.func, ast.Name) \
                     and node.func.id in CAST_BUILTINS:
+                # positional only: float/int/bool reject keyword arguments
+                # in Python 3, so there is no keyword form to police
                 if node.args and not _static_cast_arg(node.args[0]):
                     what = f"{node.func.id}()"
+            else:
+                # a sync callable handed INTO a traced call by reference
+                # (jax.tree.map(np.asarray, x)) syncs exactly like calling
+                # it — flag the reference (the round-8 audit gap)
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    ra = common.resolve(arg, ctx.aliases)
+                    if ra in SYNC_CALLS:
+                        what = f"{ra} (passed as callable)"
+                        break
             if what is None or (node.lineno, node.col_offset) in seen:
                 continue
             seen.add((node.lineno, node.col_offset))

@@ -6,6 +6,9 @@ node state ``[N, ...]`` and ring buffers ``[D, N, ...]`` are row-sharded, and
 the delivery ops in ``ops/delivery.py`` globalize sender-side quantities with
 ``all_gather``/``psum``/``pmax`` over ICI (SURVEY.md §2: the TPU-native
 equivalent of the reference's simulated point-to-point channels).
+
+All four factories here are traced over a 2-device mesh and budget-pinned
+by the graph audit (lint/graph/programs.py ``shard.*`` specs).
 """
 
 from __future__ import annotations

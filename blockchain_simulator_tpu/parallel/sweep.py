@@ -26,7 +26,11 @@ models/pbft_round.py documents vs the tick engine; counts and milestones
 are unaffected.
 
 Compiled programs live in the unified executable registry
-(utils/aotcache.py) — hit/miss stats land on every run manifest.
+(utils/aotcache.py) — hit/miss stats land on every run manifest.  The
+same-structure grouping below is pinned at the IR level by the graph
+audit's divergence twins (lint/graph/programs.py ``sweep_dynf.*``): fault
+configs differing only in counts must trace to ONE jaxpr fingerprint, or
+``lint.graph`` fails ``registry-key-divergence`` in CI.
 """
 
 from __future__ import annotations

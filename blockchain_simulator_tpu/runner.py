@@ -159,7 +159,9 @@ def make_sim_fn(cfg: SimConfig):
     Caching lives in the unified executable registry (utils/aotcache.py,
     hit/miss stats on every run manifest) rather than a per-module
     ``lru_cache``; the callable per config is still built exactly once per
-    process.
+    process.  Every engine arm this factory can dispatch to is traced and
+    budget-pinned by the graph audit (lint/graph/programs.py ``sim.*``
+    specs; ``python -m blockchain_simulator_tpu.lint.graph``).
     """
     _reject_cpp_only(cfg)
     if use_round_schedule(cfg):

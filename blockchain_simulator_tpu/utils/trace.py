@@ -91,7 +91,10 @@ def _np_series(ys) -> dict:
 # registry (utils/aotcache.py; SimConfig is frozen/hashable, the same
 # convention as runner.make_sim_fn) so a multi-seed --trace sweep compiles
 # once and reruns with fresh keys — and the hit/miss trail lands on the run
-# manifest's `cache` block.
+# manifest's `cache` block.  Every program below — including the
+# multi-program raft_hb/mixed factories' prefix/steady/cont pieces — is
+# traced and budget-pinned by the graph audit (lint/graph/programs.py
+# `trace.*` specs).
 
 @aotcache.cached_factory("trace-tick")
 def _tick_traced_fn(cfg: SimConfig):

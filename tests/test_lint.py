@@ -773,3 +773,217 @@ def test_whole_repo_zero_non_baselined_findings():
     assert new == [], "\n".join(
         f"{f.path}:{f.line}: {f.rule}: {f.message}" for f in new
     )
+
+
+# ---------------------------------------------------------------------------
+# round-8 host-sync gap closures (np.as* family, keyword casts, callable refs)
+# ---------------------------------------------------------------------------
+
+def test_host_sync_fires_on_asanyarray_family():
+    src = """
+import jax
+import numpy as np
+
+@jax.jit
+def sim(x):
+    return np.asanyarray(x) + np.ascontiguousarray(x)
+"""
+    findings, _ = run_rule(host_sync_in_traced, src)
+    msgs = " ".join(f.message for f in findings)
+    assert "numpy.asanyarray" in msgs and "numpy.ascontiguousarray" in msgs
+
+
+
+
+def test_host_sync_fires_on_callable_reference():
+    # np.asarray handed INTO a traced call syncs exactly like calling it
+    src = """
+import jax
+import numpy as np
+
+@jax.jit
+def sim(x):
+    return jax.tree.map(np.asarray, x)
+"""
+    findings, _ = run_rule(host_sync_in_traced, src)
+    assert any("passed as callable" in f.message for f in findings), findings
+
+
+def test_host_sync_jnp_callable_reference_stays_clean():
+    src = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def sim(x):
+    return jax.tree.map(jnp.asarray, x)
+"""
+    findings, _ = run_rule(host_sync_in_traced, src)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# baseline hygiene: stale suppressions + --prune-baseline
+# ---------------------------------------------------------------------------
+
+def test_stale_suppression_detected_on_full_rule_runs():
+    src = "import os  # jaxlint: disable=prng-key-reuse\nprint(os.sep)\n"
+    stale = []
+    findings, _ = engine.lint_source(src, path="f.py", stale_sup_out=stale)
+    assert findings == []
+    assert stale == [("f.py", 1, "prng-key-reuse")]
+
+
+def test_live_suppression_is_not_stale():
+    src = "import os  # jaxlint: disable=unused-import\n"
+    stale = []
+    findings, n_sup = engine.lint_source(src, path="f.py",
+                                         stale_sup_out=stale)
+    assert findings == [] and n_sup == 1
+    assert stale == []
+
+
+def test_stale_suppression_not_claimed_on_rule_subset_runs():
+    # a subset run cannot decide a directive for an un-run rule is dead
+    src = "import os  # jaxlint: disable=prng-key-reuse\nprint(os.sep)\n"
+    stale = []
+    engine.lint_source(src, path="f.py", rules=[unused_import],
+                       stale_sup_out=stale)
+    assert stale == []
+
+
+def test_prune_baseline_drops_fixed_and_keeps_firing_entries(
+    tmp_path, capsys
+):
+    a = tmp_path / "a.py"
+    a.write_text("import os\nimport sys\nprint(sys.argv)\n")
+    bl = tmp_path / "bl.json"
+    rc = engine.main([str(a), "--baseline", str(bl), "--write-baseline"])
+    capsys.readouterr()
+    assert rc == 0
+    doc = json.loads(bl.read_text())
+    assert len(doc["entries"]) == 1
+    doc["entries"][0]["justification"] = "hand-written"
+    # a second, already-fixed entry that prune must drop
+    doc["entries"].append({
+        "rule": "unused-import", "path": engine.rel_path(str(a)),
+        "text": "import gone", "count": 1, "justification": "obsolete",
+    })
+    bl.write_text(json.dumps(doc))
+
+    rc = engine.main([str(a), "--baseline", str(bl), "--prune-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "pruned 1 entr(ies)" in out
+    doc = json.loads(bl.read_text())
+    assert len(doc["entries"]) == 1
+    assert doc["entries"][0]["text"] == "import os"
+    assert doc["entries"][0]["justification"] == "hand-written"
+
+
+def test_prune_baseline_shrinks_overcounted_entries(tmp_path, capsys):
+    a = tmp_path / "a.py"
+    a.write_text("import os\nimport sys\nprint(sys.argv)\n")
+    bl = tmp_path / "bl.json"
+    rc = engine.main([str(a), "--baseline", str(bl), "--write-baseline"])
+    capsys.readouterr()
+    assert rc == 0
+    doc = json.loads(bl.read_text())
+    doc["entries"][0]["count"] = 5  # overcounted: only 1 still fires
+    bl.write_text(json.dumps(doc))
+    rc = engine.main([str(a), "--baseline", str(bl), "--prune-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "reduced 1" in out
+    doc = json.loads(bl.read_text())
+    assert doc["entries"][0]["count"] == 1
+
+
+def test_prune_baseline_preserves_out_of_scope_entries(tmp_path, capsys):
+    a = tmp_path / "a.py"
+    a.write_text("import os\nimport sys\nprint(sys.argv)\n")
+    # b exists on disk but is NOT linted this run: not decidable, preserved
+    b = tmp_path / "b.py"
+    b.write_text("import os\nprint(os.sep)\n")
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps({
+        "jaxlint_baseline": 1,
+        "entries": [
+            {"rule": "unused-import", "path": str(b),
+             "text": "import x", "count": 2, "justification": "elsewhere"},
+        ],
+    }))
+    rc = engine.main([str(a), "--baseline", str(bl), "--prune-baseline"])
+    capsys.readouterr()
+    assert rc == 0
+    doc = json.loads(bl.read_text())
+    # the out-of-scope entry survives untouched; a's finding is NOT added
+    # (prune only removes/shrinks — growing the baseline is --write-baseline)
+    assert len(doc["entries"]) == 1
+    assert doc["entries"][0]["path"] == str(b)
+    assert doc["entries"][0]["count"] == 2
+
+
+def test_prune_baseline_reports_stale_suppressions(tmp_path, capsys):
+    a = tmp_path / "a.py"
+    a.write_text(
+        "import sys  # jaxlint: disable=prng-key-reuse\nprint(sys.argv)\n"
+    )
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps({"jaxlint_baseline": 1, "entries": []}))
+    rc = engine.main([str(a), "--baseline", str(bl), "--prune-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "stale suppression" in out and "prng-key-reuse" in out
+
+
+def test_cli_json_reports_stale_suppressions(tmp_path, capsys):
+    a = tmp_path / "a.py"
+    a.write_text(
+        "import sys  # jaxlint: disable=prng-key-reuse\nprint(sys.argv)\n"
+    )
+    rc = engine.main([str(a), "--format", "json", "--no-baseline"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["stale_suppressions"] == [
+        {"path": engine.rel_path(str(a)), "line": 1,
+         "rule": "prng-key-reuse"},
+    ]
+
+
+def test_whole_repo_has_no_stale_suppressions():
+    """Every inline `# jaxlint: disable=` in the committed tree still
+    suppresses a live finding (the --prune-baseline hygiene contract)."""
+    paths = [os.path.join(engine.REPO_ROOT, "blockchain_simulator_tpu"),
+             os.path.join(engine.REPO_ROOT, "tools"),
+             os.path.join(engine.REPO_ROOT, "bench.py")]
+    stale = []
+    _, _, _, errors = engine.lint_paths(paths, stale_sup_out=stale)
+    assert errors == []
+    assert stale == [], stale
+
+
+def test_prune_baseline_drops_entries_for_deleted_files(tmp_path, capsys):
+    a = tmp_path / "a.py"
+    a.write_text("import os\nimport sys\nprint(sys.argv)\n")
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps({
+        "jaxlint_baseline": 1,
+        "entries": [
+            {"rule": "unused-import", "path": str(tmp_path / "gone.py"),
+             "text": "import x", "count": 1, "justification": "dead"},
+        ],
+    }))
+    rc = engine.main([str(a), "--baseline", str(bl), "--prune-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "pruned 1 entr(ies)" in out
+    assert json.loads(bl.read_text())["entries"] == []
+
+
+def test_prune_baseline_corrupt_baseline_exits_2(tmp_path, capsys):
+    a = tmp_path / "a.py"
+    a.write_text("import sys\nprint(sys.argv)\n")
+    bl = tmp_path / "bl.json"
+    bl.write_text("{not json")
+    rc = engine.main([str(a), "--baseline", str(bl), "--prune-baseline"])
+    err = capsys.readouterr().err
+    assert rc == 2 and "bad baseline" in err

@@ -120,8 +120,15 @@ def trajectory(rows: list[dict]) -> dict[str, list[dict]]:
 # own exit code — applying the throughput rule would flag *fixing* findings
 # as a regression.  Same carve-out for compile_s trajectories: dropping
 # compile wall (warm persistent-cache runs, utils/aotcache.py) is the GOAL,
-# and the throughput rule would read it as a 10x regression.
+# and the throughput rule would read it as a 10x regression.  The jaxgraph
+# per-program cost trajectories ("graph_<program>_gflops"/"_bytes",
+# lint/graph) are the same shape: shrinking a program is the goal, and
+# growth is already gated against GRAPH_BASELINE.json by the lint.graph
+# budget gate — chart, never gate.  Keyed on the "graph_" PREFIX, not the
+# unit suffixes: a future bench metric like "peak_rss_bytes", where a drop
+# IS meaningful, must stay under the throughput rule.
 UNGATED_SUFFIXES = ("_findings", "_compile_s")
+UNGATED_PREFIXES = ("graph_",)
 
 
 def compile_s_rows(rows: list[dict]) -> list[dict]:
@@ -141,7 +148,8 @@ def check_regressions(by_metric: dict, threshold: float) -> list[str]:
     ``last < (1 - threshold) * prev``."""
     failures = []
     for metric, rows in by_metric.items():
-        if metric.endswith(UNGATED_SUFFIXES):
+        if metric.endswith(UNGATED_SUFFIXES) \
+                or metric.startswith(UNGATED_PREFIXES):
             continue
         vals = [r["value"] for r in rows if isinstance(r["value"], (int, float))]
         if len(vals) < 2:

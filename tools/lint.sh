@@ -1,15 +1,23 @@
 #!/usr/bin/env bash
-# CI gate: jaxlint (new findings vs LINT_BASELINE.json) chained with the
+# CI gate: jaxlint (new findings vs LINT_BASELINE.json), jaxgraph (IR-level
+# audit + FLOP/byte budget gate vs GRAPH_BASELINE.json), and the
 # bench_compare perf-regression gate over the committed BENCH_*.json history.
 #
-# Exit 0 only when BOTH pass:
+# Exit 0 only when ALL pass:
 #   - `python -m blockchain_simulator_tpu.lint --format json` reports zero
 #     non-baselined findings (exit 1 on any new finding, 2 on parse errors);
+#   - `python -m blockchain_simulator_tpu.lint.graph --format json` traces
+#     every registered executable factory and reports zero non-baselined IR
+#     findings / budget regressions (GRAPH=0 skips — it costs ~1.5 min of
+#     tracing on the 2-core box);
 #   - `tools/bench_compare.py` sees no metric drop beyond its threshold.
 #
-# When $BLOCKSIM_RUNS_JSONL is set the lint run itself lands in runs.jsonl
-# (one line, metric "jaxlint_new_findings") via utils/obs.py, so the findings
-# trajectory is charted by bench_compare next to the perf history.
+# When $BLOCKSIM_RUNS_JSONL is set the lint runs themselves land in
+# runs.jsonl (metrics "jaxlint_new_findings", "jaxgraph_new_findings", and
+# per-program "graph_*_gflops"/"graph_*_bytes") via utils/obs.py, so the
+# findings + budget trajectories are charted by bench_compare next to the
+# perf history (*_findings metrics and the graph_* prefix are never gated
+# there — the budget gate lives in lint.graph itself).
 #
 # After both gates, tools/warm_bench.sh measures the cold-vs-warm compile
 # split of the CPU fallback bench against a persistent compile cache
@@ -29,6 +37,16 @@ lint_rc=$?
 if [ "$lint_rc" -ne 0 ]; then
     echo "lint.sh: jaxlint FAILED (rc=$lint_rc)" >&2
     rc=1
+fi
+
+if [ "${GRAPH:-1}" != "0" ]; then
+    echo "== jaxgraph =="
+    python -m blockchain_simulator_tpu.lint.graph --format json
+    graph_rc=$?
+    if [ "$graph_rc" -ne 0 ]; then
+        echo "lint.sh: jaxgraph FAILED (rc=$graph_rc)" >&2
+        rc=1
+    fi
 fi
 
 echo "== bench_compare =="

@@ -230,7 +230,7 @@ def build_catalog() -> list[ProgramSpec]:
         "sweep_batched.pbft_tick", "sweep-batched", build_batched
     ))
 
-    # --- parallel/sweep._dyn_batched_fn ("sweep-batched-dynf") ----------
+    # --- parallel/sweep.dyn_batched_fn ("sweep-batched-dynf") -----------
     # Divergence twins: fault configs that differ only in COUNTS must trace
     # to ONE jaxpr after canonicalization — otherwise run_fault_sweep's
     # same-structure grouping silently recompiles per point (the leak the
@@ -263,6 +263,31 @@ def build_catalog() -> list[ProgramSpec]:
                            {"n_crashed": 1}, "dynf:raft_tick", True))
     specs.append(dynf_spec("sweep_dynf.raft_c2", "raft_tick",
                            {"n_crashed": 2}, "dynf:raft_tick", False))
+
+    # --- serve/dispatch._solo_fn ("serve-solo") -------------------------
+    # The scenario server's un-vmapped degrade/solo path.  Divergence
+    # twins mirror the dynf pair: requests differing only in fault counts
+    # (or seed — canonical_fault_cfg normalizes both) must trace to ONE
+    # fingerprint, or the server silently recompiles per request.
+    def serve_solo_spec(name, fc_kw, seed, budget):
+        def build():
+            import dataclasses as _dc
+
+            from blockchain_simulator_tpu.serve import dispatch
+
+            cfg = cfgs["pbft_tick"].with_(seed=seed)
+            cfg = cfg.with_(faults=_dc.replace(cfg.faults, **fc_kw))
+            fn = _raw(dispatch._solo_fn)(cfg)
+            return fn, (_key_sds(), _i32_sds(), _i32_sds())
+
+        return ProgramSpec(name, "serve-solo", build,
+                           divergence_group="serve-solo:pbft_tick",
+                           budget=budget)
+
+    specs.append(serve_solo_spec("serve_solo.pbft", {"n_byzantine": 1}, 0,
+                                 True))
+    specs.append(serve_solo_spec("serve_solo.pbft_b2_s7", {"n_byzantine": 2},
+                                 7, False))
 
     # --- parallel/shard.py factories ------------------------------------
     def shard_spec(program, factory, fget, arm):

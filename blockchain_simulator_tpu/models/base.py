@@ -82,7 +82,10 @@ def canonical_fault_cfg(cfg):
     """The ONE static config whose dynamic-operand trace serves every
     (n_crashed, n_byzantine) point of a count sweep: counts zeroed to the
     FaultConfig defaults so every sweep over the same fault *structure*
-    (drop_prob, byz_forge, byz_copies) shares one registry key.
+    (drop_prob, byz_forge, byz_copies) shares one registry key.  ``seed``
+    is normalized too — it never enters the trace (the PRNG key is a
+    per-lane operand), so scenario requests and sweeps differing only in
+    seed must share one executable (the serve/ batch-group contract).
 
     ``byz_forge`` keeps a static ``n_byzantine=1`` sentinel: pbft.step
     includes the forge wave in the trace only when the static count is
@@ -94,6 +97,7 @@ def canonical_fault_cfg(cfg):
 
     f = cfg.faults
     return cfg.with_(
+        seed=0,
         faults=dataclasses.replace(
             f,
             crash_frac=0.0,

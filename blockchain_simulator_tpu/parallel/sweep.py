@@ -42,7 +42,12 @@ import jax.numpy as jnp
 
 from blockchain_simulator_tpu.models.base import canonical_fault_cfg, get_protocol
 from blockchain_simulator_tpu.parallel.mesh import SWEEP_AXIS
-from blockchain_simulator_tpu.runner import make_dyn_sim_fn, make_sim_fn
+from blockchain_simulator_tpu.runner import (
+    UnbatchableConfigError,
+    check_batchable,
+    make_dyn_sim_fn,
+    make_sim_fn,
+)
 from blockchain_simulator_tpu.utils import aotcache, obs
 from blockchain_simulator_tpu.utils.config import SimConfig
 
@@ -63,11 +68,19 @@ def _batched_fn(cfg: SimConfig, mesh=None):
 
 
 @aotcache.cached_factory("sweep-batched-dynf")
-def _dyn_batched_fn(cfg: SimConfig):
+def dyn_batched_fn(cfg: SimConfig):
     """Jitted ``batched(keys, n_crashed[B], n_byzantine[B]) -> finals`` —
     THE one executable of a whole fault-count sweep (``cfg`` must already be
-    canonical; one registry entry per fault structure)."""
+    canonical; one registry entry per fault structure).  Public: the
+    scenario server's micro-batched dispatch (serve/dispatch.py) rides the
+    same registry entry as the sweeps, so a sweep warms the server and
+    vice versa."""
     return jax.jit(jax.vmap(make_dyn_sim_fn(cfg)))
+
+
+# back-compat alias (pre-serve name; lint/graph/programs.py and external
+# callers were updated, but keep the old spelling importable)
+_dyn_batched_fn = dyn_batched_fn
 
 
 def run_seed_sweep(cfg: SimConfig, seeds, mesh=None):
@@ -106,29 +119,57 @@ def _dyn_operands(cfg: SimConfig, fc) -> tuple[int, int]:
     return fc.resolved_n_crashed(cfg.n), fc.n_byzantine
 
 
+def run_dyn_points(canon: SimConfig, points, record: bool = True,
+                   n_out: int | None = None):
+    """THE group-dispatch primitive: one vmapped executable over an
+    arbitrary list of same-structure ``(cfg, seed)`` points.
+
+    ``points`` is a sequence of ``(cfg, seed)`` pairs whose configs all
+    canonicalize to ``canon`` (``canonical_fault_cfg``) — they may differ
+    only in fault COUNTS, which become the traced per-lane operands.
+    Returns one metrics dict per point, in order, each bit-equal (exact
+    sampler; see the module caveat for the normal CLT path) to a solo run
+    of the same ``(cfg, seed)``.
+
+    Both the fault sweeps (:func:`run_fault_sweep`, a cross product of
+    points) and the scenario server's micro-batched dispatch
+    (serve/dispatch.py, whatever compatible requests are queued) route
+    through here.  ``record=False`` skips the per-row runs.jsonl hook for
+    callers that write their own access-log records (the server does);
+    ``n_out`` computes host-side metrics for only the first ``n_out``
+    points (the server's bucket-padded lanes are duplicates whose metrics
+    would be discarded)."""
+    points = list(points)
+    keys = jax.vmap(jax.random.key)(
+        jnp.asarray([s for _, s in points], jnp.uint32)
+    )
+    ops = [_dyn_operands(cfg, cfg.faults) for cfg, _ in points]
+    nc = jnp.asarray([o[0] for o in ops], jnp.int32)
+    nb = jnp.asarray([o[1] for o in ops], jnp.int32)
+    finals = jax.block_until_ready(dyn_batched_fn(canon)(keys, nc, nb))
+    out = []
+    if n_out is not None:
+        points = points[:n_out]
+    for i, (cfg_i, seed) in enumerate(points):
+        proto = get_protocol(cfg_i.protocol)
+        final_i = jax.tree.map(lambda x: x[i], finals)
+        m = proto.metrics(cfg_i, final_i)
+        if record:
+            obs.record_run({"seed": int(seed), **m}, cfg_i)
+        out.append(m)
+    return out
+
+
 def _run_dyn_group(cfg: SimConfig, canon: SimConfig, fcs, seeds):
     """One compiled program for every (fault config, seed) point of a
     same-structure group; returns {fc: [metrics per seed]} with rows
     bit-equal to ``run_seed_sweep(cfg.with_(faults=fc), seeds)``."""
+    points = [(cfg.with_(faults=fc), seed) for fc in fcs for seed in seeds]
+    rows = run_dyn_points(canon, points)
     n_s = len(seeds)
-    seed_rep = list(seeds) * len(fcs)
-    keys = jax.vmap(jax.random.key)(jnp.asarray(seed_rep, jnp.uint32))
-    ncs, nbs = zip(*(_dyn_operands(cfg, fc) for fc in fcs))
-    nc = jnp.repeat(jnp.asarray(ncs, jnp.int32), n_s)
-    nb = jnp.repeat(jnp.asarray(nbs, jnp.int32), n_s)
-    finals = jax.block_until_ready(_dyn_batched_fn(canon)(keys, nc, nb))
-    results = {}
-    for i, fc in enumerate(fcs):
-        cfg_fc = cfg.with_(faults=fc)
-        proto = get_protocol(cfg_fc.protocol)
-        rows = []
-        for j, seed in enumerate(seeds):
-            final_ij = jax.tree.map(lambda x: x[i * n_s + j], finals)
-            m = proto.metrics(cfg_fc, final_ij)
-            obs.record_run({"seed": int(seed), **m}, cfg_fc)
-            rows.append(m)
-        results[fc] = rows
-    return results
+    return {
+        fc: rows[i * n_s:(i + 1) * n_s] for i, fc in enumerate(fcs)
+    }
 
 
 def run_fault_sweep(cfg: SimConfig, fault_configs, seeds):
@@ -141,13 +182,17 @@ def run_fault_sweep(cfg: SimConfig, fault_configs, seeds):
     drop_prob / byz_forge / byz_copies) land in separate groups, each with
     its own dynamic-operand compile — same compile count as the old
     per-config loop, and future same-structure sweeps reuse the entry.
-    Only the mixed shard sim takes the static ``run_seed_sweep`` path
+    Un-batchable configs (today: the mixed shard sim — the typed
+    ``runner.UnbatchableConfigError``, classified here without
+    string-matching) take the static ``run_seed_sweep`` path
     (one static compile per fault config)."""
     fault_configs = list(fault_configs)
     groups: dict[SimConfig, list] = {}
     order = {}
     for fc in fault_configs:
-        if cfg.protocol == "mixed":
+        try:
+            check_batchable(cfg.with_(faults=fc))
+        except UnbatchableConfigError:
             order[fc] = None
             continue
         canon = canonical_fault_cfg(cfg.with_(faults=fc))

@@ -21,6 +21,30 @@ from blockchain_simulator_tpu.utils.config import SimConfig
 from blockchain_simulator_tpu.utils.sync import force_sync
 
 
+class UnbatchableConfigError(NotImplementedError):
+    """A config whose faults cannot become traced per-run operands — it has
+    no dynamic-fault-operand program (``make_dyn_sim_fn``), so it can join
+    neither a compile-once sweep group (parallel/sweep.py) nor a micro-batched
+    serving dispatch (serve/).
+
+    Typed so the sweep layer and the scenario server classify the refusal
+    without string-matching; subclasses ``NotImplementedError`` so historical
+    ``except NotImplementedError`` call sites keep working."""
+
+
+def check_batchable(cfg: SimConfig) -> None:
+    """Raise :class:`UnbatchableConfigError` when ``cfg`` has no
+    dynamic-fault-operand program.  Currently that is exactly the mixed
+    shard sim: its faults are per-shard *init structure*, not maskable
+    state (models/base.apply_fault_masks)."""
+    if cfg.protocol == "mixed":
+        raise UnbatchableConfigError(
+            "dynamic fault operands are not implemented for the mixed shard "
+            "sim (faults live at the raft-shard level, models/mixed.py); "
+            "sweep it with one static compile per fault config"
+        )
+
+
 def use_round_schedule(cfg: SimConfig) -> bool:
     """Resolve cfg.schedule: does this config run a phase-blocked fast path
     (PBFT: one scan step per block interval; raft: per heartbeat; mixed: the
@@ -221,15 +245,11 @@ def make_dyn_sim_fn(cfg: SimConfig):
     (pinned in tests/test_zsweep_cache.py).  Returns the UNJITTED function:
     the sweep layer owns the single ``jit(vmap(...))`` wrapper, so an
     f-sweep costs exactly one executable.  The mixed shard sim distributes
-    faults per shard at init and is refused."""
+    faults per shard at init and is refused with a typed
+    :class:`UnbatchableConfigError` (:func:`check_batchable`)."""
     cfg = base_model.canonical_fault_cfg(cfg)
+    check_batchable(cfg)
     _reject_cpp_only(cfg)
-    if cfg.protocol == "mixed":
-        raise NotImplementedError(
-            "dynamic fault operands are not implemented for the mixed shard "
-            "sim (faults live at the raft-shard level, models/mixed.py); "
-            "sweep it with one static compile per fault config"
-        )
     n = cfg.n
 
     if use_round_schedule(cfg):

@@ -1,0 +1,23 @@
+"""Scenario serving: the warm-executable simulation daemon.
+
+``python -m blockchain_simulator_tpu.serve`` runs the HTTP daemon
+(serve/__main__.py); :class:`ScenarioServer` is the in-process core the
+daemon, tools/serve_bench.py and the tests drive.  See README "Scenario
+serving" for the request schema and knobs.
+"""
+
+from blockchain_simulator_tpu.serve.schema import (  # noqa: F401
+    AdmissionPausedError,
+    InvalidRequestError,
+    QueueFullError,
+    RequestTimeoutError,
+    ScenarioRequest,
+    ServeError,
+    ShuttingDownError,
+    UnbatchableRequestError,
+    parse_request,
+)
+from blockchain_simulator_tpu.serve.server import (  # noqa: F401
+    PendingResponse,
+    ScenarioServer,
+)

@@ -1,0 +1,148 @@
+"""Micro-batched scenario dispatch: N queued requests, one vmapped program.
+
+The execution substrate of the scenario server (serve/server.py).  A batch
+is a list of admitted :class:`~blockchain_simulator_tpu.serve.schema.
+ScenarioRequest` sharing one canonical fault structure (their batch group);
+dispatch runs them as ONE vmapped dynamic-fault-operand executable — the
+same ``parallel/sweep.dyn_batched_fn`` registry entry the fault sweeps
+compile, so a warm sweep cache serves traffic with zero compiles.
+
+Batch-size buckets: a vmapped executable is shape-specialized on its batch
+axis, so serving raw queue depths would compile one program per observed
+batch size.  Batches are instead padded up to the next power-of-two bucket
+(capped at the server's ``max_batch``) by repeating the last lane — at most
+``log2(max_batch) + 1`` executables per group ever exist, and a padded lane
+costs one discarded vmap lane of compute.  The occupancy histogram on the
+stats endpoint makes the padding observable (KNOWN_ISSUES: the
+batching/latency trade-off entry).
+
+Robustness: a failed batched dispatch degrades to per-request solo
+dispatch (``serve-solo`` executable, also registry-cached) so one poisoned
+request fails alone — its peers still get answers — and every lane failure
+surfaces as a typed :class:`~blockchain_simulator_tpu.serve.schema.
+ServeError` response, never a crashed daemon.
+
+Bit-equality: under ``stat_sampler="exact"`` a request's metrics are
+bit-equal whether served solo, batched, or padded (integer draws from the
+same per-lane key); the ``"normal"`` CLT sampler keeps the ±1-tick float
+caveat documented in parallel/sweep.py.  tests/test_zserve.py pins the
+exact-sampler equalities; tools/serve_bench.py re-checks them on the
+artifact workload.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from blockchain_simulator_tpu.models.base import get_protocol
+from blockchain_simulator_tpu.runner import make_dyn_sim_fn
+from blockchain_simulator_tpu.serve import schema
+from blockchain_simulator_tpu.utils import aotcache, obs
+
+
+@aotcache.cached_factory("serve-solo")
+def _solo_fn(canon):
+    """Jitted ``sim(key, n_crashed, n_byzantine) -> final`` for one
+    canonical fault structure: the un-vmapped degrade/solo path of the
+    scenario server.  One registry entry per structure serves every
+    (seed, fault count) request solo — the serving analog of the sweep
+    contract, audited as ``serve_solo.*`` in lint/graph/programs.py."""
+    return jax.jit(make_dyn_sim_fn(canon))
+
+
+def bucket_size(n: int, max_batch: int) -> int:
+    """The padded batch size actually dispatched for ``n`` queued requests:
+    next power of two >= n, capped at ``max_batch`` (n is never above it —
+    the batcher flushes at max_batch)."""
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, max_batch)
+
+
+def _operands(reqs):
+    """(keys[B], n_crashed[B], n_byzantine[B]) for a padded request list."""
+    keys = jax.vmap(jax.random.key)(
+        jnp.asarray([r.seed for r in reqs], jnp.uint32)
+    )
+    nc = jnp.asarray(
+        [r.cfg.faults.resolved_n_crashed(r.cfg.n) for r in reqs], jnp.int32
+    )
+    nb = jnp.asarray([r.cfg.faults.n_byzantine for r in reqs], jnp.int32)
+    return keys, nc, nb
+
+
+def _solo_metrics(req):
+    """Run one request through the solo executable; returns its metrics."""
+    keys, nc, nb = _operands([req])
+    final = jax.block_until_ready(
+        _solo_fn(req.canon)(keys[0], nc[0], nb[0])
+    )
+    return get_protocol(req.cfg.protocol).metrics(req.cfg, final)
+
+
+def run_batch(reqs, max_batch: int) -> list[tuple]:
+    """Dispatch one same-group batch; returns ``[(req, response)]`` in
+    order, one entry per request, every response either 200 or a typed
+    error body.
+
+    One request dispatches solo; two or more dispatch as one vmapped
+    executable over the bucket-padded lane set.  Any batched failure
+    degrades to per-request solo dispatch (the failure count lands in the
+    server's ``degraded_batches`` stat via the ``degraded`` flag)."""
+    t0 = time.monotonic()
+    canon = reqs[0].canon
+    group = obs.config_hash(canon)
+    if len(reqs) == 1:
+        req = reqs[0]
+        batch = {"size": 1, "padded": 1, "mode": "solo", "group": group}
+        try:
+            m = _solo_metrics(req)
+        except Exception as e:  # typed, never a crashed worker
+            err = schema.ServeError(f"solo dispatch failed: "
+                                    f"{type(e).__name__}: {e}")
+            return [(req, err.to_response(req.req_id))]
+        latency = time.monotonic() - (req.submitted or t0)
+        return [(req, schema.ok_response(req, m, batch, latency))]
+
+    padded = bucket_size(len(reqs), max_batch)
+    lanes = list(reqs) + [reqs[-1]] * (padded - len(reqs))
+    batch = {"size": len(reqs), "padded": padded, "mode": "batched",
+             "group": group}
+    try:
+        from blockchain_simulator_tpu.parallel import sweep
+
+        # the sweeps' group-dispatch primitive, fed the queue instead of a
+        # cross product; record=False — the server writes its own per-
+        # request access-log records; n_out skips pad-lane metrics
+        rows = sweep.run_dyn_points(
+            canon, [(r.cfg, r.seed) for r in lanes], record=False,
+            n_out=len(reqs),
+        )
+        out = []
+        for req, m in zip(reqs, rows):
+            latency = time.monotonic() - (req.submitted or t0)
+            out.append((req, schema.ok_response(req, m, batch, latency)))
+        return out
+    except Exception:
+        # a batch peer failed: serve every lane solo so one poisoned
+        # request cannot take its neighbors' answers down with it
+        out = []
+        solo = {"size": len(reqs), "padded": 1, "mode": "degraded-solo",
+                "group": group, "degraded": True}
+        for req in reqs:
+            try:
+                m = _solo_metrics(req)
+            except Exception as e:
+                err = schema.ServeError(
+                    f"dispatch failed (batched, then solo): "
+                    f"{type(e).__name__}: {e}"
+                )
+                out.append((req, err.to_response(req.req_id)))
+                continue
+            latency = time.monotonic() - (req.submitted or t0)
+            out.append((req, schema.ok_response(req, m, solo, latency)))
+        return out

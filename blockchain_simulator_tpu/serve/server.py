@@ -1,0 +1,407 @@
+"""ScenarioServer: the long-lived, micro-batching scenario-serving core.
+
+The in-process API the daemon (serve/__main__.py), the bench
+(tools/serve_bench.py) and the tests drive:
+
+- :meth:`ScenarioServer.submit` — admission-checked enqueue; returns a
+  :class:`PendingResponse` future.  Rejections raise typed
+  :class:`~blockchain_simulator_tpu.serve.schema.ServeError` subclasses
+  AFTER recording a rejection manifest in the access log — nothing is
+  dropped silently.
+- :meth:`ScenarioServer.request` — submit + wait; always returns a
+  response dict (errors become 4xx/5xx bodies), the daemon's HTTP shape.
+- one background **batcher** thread: pulls admitted requests, groups them
+  by canonical fault structure (their batch group, schema.parse_request),
+  and flushes a group when it reaches ``max_batch`` or its oldest request
+  has waited ``max_wait_ms`` — the two knobs of the batching/latency
+  trade-off.  Dispatch is serve/dispatch.py: one vmapped executable per
+  flush, answered from the warm registry/AOT cache.
+
+Admission is gated on backend health (utils/health.py): a ``sick``/
+``wedged`` verdict — seeded from the rolling HEALTH.jsonl at startup or
+pushed via :meth:`set_health` — pauses admission with typed 503s until a
+``healthy`` verdict resumes it.  The access log is utils/obs.py
+``record_run``: one finalized manifest line per served OR rejected request
+in runs.jsonl (``$BLOCKSIM_RUNS_JSONL``), cache hit/miss provenance
+included.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+
+from blockchain_simulator_tpu.serve import dispatch, schema
+from blockchain_simulator_tpu.utils import aotcache, obs
+
+_SHUTDOWN = object()
+
+
+class PendingResponse:
+    """Future for one admitted request: ``result()`` blocks until the
+    batcher answers.  A ``wait_s`` elapsing returns a typed 504 body
+    without un-queueing the request (the server-side ``timeout_s`` is the
+    authoritative per-request timeout)."""
+
+    __slots__ = ("_event", "_response", "req_id")
+
+    def __init__(self, req_id: str):
+        self._event = threading.Event()
+        self._response = None
+        self.req_id = req_id
+
+    def _set(self, response: dict) -> None:
+        self._response = response
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, wait_s: float | None = None) -> dict:
+        if not self._event.wait(wait_s):
+            return schema.RequestTimeoutError(
+                f"no response within wait_s={wait_s}"
+            ).to_response(self.req_id)
+        return self._response
+
+
+class ScenarioServer:
+    """See the module docstring.  ``start=False`` builds the server without
+    its batcher thread (the backpressure tests fill the queue that way);
+    call :meth:`start` later.  Always :meth:`close` (or use as a context
+    manager) — it drains the queue, answering every admitted request."""
+
+    def __init__(
+        self,
+        max_batch: int = 8,
+        max_wait_ms: float = 25.0,
+        max_queue: int = 64,
+        default_timeout_s: float = 30.0,
+        health_log: str | None = None,
+        start: bool = True,
+    ):
+        if max_batch < 1 or max_queue < 1:
+            raise ValueError("max_batch and max_queue must be >= 1")
+        self.max_batch = int(max_batch)
+        self.max_wait_ms = float(max_wait_ms)
+        self.max_queue = int(max_queue)
+        self.default_timeout_s = float(default_timeout_s)
+
+        self._arrivals: queue.Queue = queue.Queue()
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._depth = 0          # admitted, not yet answered
+        self._health: dict = {"verdict": "healthy", "source": "default"}
+        if health_log:
+            from blockchain_simulator_tpu.utils import health as health_mod
+
+            rec = health_mod.latest_verdict(health_log)
+            if rec is not None:
+                self._health = {"verdict": rec["verdict"],
+                                "source": health_log}
+        self._stats = {
+            "received": 0, "served": 0, "timeouts": 0, "batches": 0,
+            "degraded_batches": 0, "rejected": {}, "errors": 0,
+        }
+        self._occupancy: dict[int, int] = {}
+        self._closing = False
+        self._thread: threading.Thread | None = None
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._batcher, name="scenario-batcher", daemon=True
+            )
+            self._thread.start()
+
+    def close(self) -> None:
+        """Stop admitting, drain the queue (every admitted request gets its
+        answer), stop the batcher."""
+        with self._lock:
+            if self._closing:
+                return
+            self._closing = True
+        if self._thread is not None and self._thread.is_alive():
+            self._arrivals.put(_SHUTDOWN)
+            self._thread.join()
+        self._thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------ admission
+    def set_health(self, verdict) -> dict:
+        """Push a health verdict (a ``utils/health.py`` record or a bare
+        verdict string): anything but ``healthy`` pauses admission; a
+        ``healthy`` verdict resumes it."""
+        if isinstance(verdict, dict):
+            rec = {"verdict": verdict.get("verdict"), "source": "pushed"}
+        else:
+            rec = {"verdict": str(verdict), "source": "pushed"}
+        with self._lock:
+            self._health = rec
+        return rec
+
+    @property
+    def paused(self) -> bool:
+        return self._health["verdict"] != "healthy"
+
+    def _reject(self, err: schema.ServeError, req_id: str | None,
+                cfg=None) -> schema.ServeError:
+        """Count + access-log a rejection BEFORE the caller sees it: the
+        no-silent-drop contract — every backpressure/admission/validation
+        refusal leaves a manifest line when the access log is enabled."""
+        with self._lock:
+            by_kind = self._stats["rejected"]
+            by_kind[err.kind] = by_kind.get(err.kind, 0) + 1
+        obs.record_run(err.to_response(req_id), cfg)
+        return err
+
+    def submit(self, obj: dict) -> PendingResponse:
+        """Admission-check + enqueue one JSON scenario request.  Raises a
+        typed :class:`~blockchain_simulator_tpu.serve.schema.ServeError`
+        (already access-logged) on rejection."""
+        with self._lock:
+            self._stats["received"] += 1
+            req_id = str((obj or {}).get("id", "")
+                         if isinstance(obj, dict) else "") \
+                or f"r{next(self._ids)}"
+            closing, health = self._closing, dict(self._health)
+        if closing:
+            raise self._reject(
+                schema.ShuttingDownError("server is draining"), req_id)
+        if health["verdict"] != "healthy":
+            raise self._reject(
+                schema.AdmissionPausedError(
+                    f"admission paused: backend health verdict is "
+                    f"{health['verdict']!r} (source: {health['source']})"
+                ),
+                req_id,
+            )
+        try:
+            req = schema.parse_request(
+                obj, req_id, default_timeout_s=self.default_timeout_s
+            )
+        except schema.ServeError as e:
+            raise self._reject(e, req_id)
+        pending = PendingResponse(req.req_id)
+        # depth check, flag re-check and enqueue are ONE atomic step: after
+        # close() flips _closing under this lock, nothing new can enter the
+        # arrivals queue, so the batcher's drain is complete
+        with self._lock:
+            full = self._depth >= self.max_queue
+            closing = self._closing
+            if not full and not closing:
+                self._depth += 1
+                req.submitted = time.monotonic()
+                self._arrivals.put((req, pending))
+        if closing:
+            raise self._reject(
+                schema.ShuttingDownError("server is draining"),
+                req.req_id, req.cfg)
+        if full:
+            raise self._reject(
+                schema.QueueFullError(
+                    f"queue at capacity ({self.max_queue}); retry later"
+                ),
+                req.req_id, req.cfg,
+            )
+        return pending
+
+    def request(self, obj: dict, wait_s: float | None = None) -> dict:
+        """submit + wait: always returns a response dict — typed rejections
+        become their 4xx/5xx bodies (the daemon's HTTP surface)."""
+        try:
+            pending = self.submit(obj)
+        except schema.ServeError as e:
+            req_id = obj.get("id") if isinstance(obj, dict) else None
+            return e.to_response(req_id)
+        return pending.result(wait_s)
+
+    # -------------------------------------------------------------- batcher
+    def _batcher(self) -> None:
+        """The micro-batching loop: accumulate per-group, flush a group at
+        ``max_batch`` depth or ``max_wait_ms`` age, drain on shutdown."""
+        pending: dict = {}  # canon cfg -> list[(req, PendingResponse)]
+        closing = False
+        while True:
+            max_wait = self.max_wait_ms / 1000.0
+            timeout = None if not pending else max_wait / 4 if max_wait > 0 \
+                else 0.001
+            try:
+                item = self._arrivals.get(timeout=timeout)
+            except queue.Empty:
+                item = None
+            # drain everything already queued before deciding what is due:
+            # a dispatch takes long enough that several arrivals pile up
+            # behind it, and admitting them one per flush would serve a
+            # saturated queue solo forever (head-of-line anti-batching)
+            while item is not None:
+                if item is _SHUTDOWN:
+                    closing = True
+                else:
+                    req, fut = item
+                    pending.setdefault(req.canon, []).append((req, fut))
+                try:
+                    item = self._arrivals.get_nowait()
+                except queue.Empty:
+                    item = None
+
+            now = time.monotonic()
+            for canon in list(pending):
+                group = pending[canon]
+                due = (
+                    closing
+                    or len(group) >= self.max_batch
+                    or (now - group[0][0].submitted) * 1000.0
+                    >= self.max_wait_ms
+                )
+                if due:
+                    del pending[canon]
+                    # the drain above can grow a group past max_batch in
+                    # one iteration — dispatch in max_batch chunks.  The
+                    # guard is the daemon's last line: dispatch failures
+                    # are already typed inside run_batch, so anything
+                    # reaching here is a server bug — fail THIS group's
+                    # futures and keep serving rather than wedge every
+                    # future client behind a dead batcher thread.
+                    for i in range(0, len(group), self.max_batch):
+                        chunk = group[i:i + self.max_batch]
+                        try:
+                            self._flush(chunk)
+                        except Exception as e:
+                            self._fail_group(chunk, e)
+            if closing and not pending and self._arrivals.empty():
+                return
+
+    def _fail_group(self, group, exc: Exception) -> None:
+        """Answer every still-unanswered future of a group with a typed 500
+        after an unexpected batcher error (never a wedged daemon)."""
+        err = schema.ServeError(
+            f"internal batcher error: {type(exc).__name__}: {exc}"
+        )
+        for req, fut in group:
+            if fut.done():
+                continue
+            with self._lock:
+                self._depth -= 1
+                self._stats["errors"] += 1
+            try:
+                obs.record_run(err.to_response(req.req_id), req.cfg)
+            except Exception:
+                pass  # the access log must never block the answer
+            fut._set(err.to_response(req.req_id))
+
+    def _flush(self, group) -> None:
+        """Dispatch one due group: expire stale requests, run the rest as
+        one batch (serve/dispatch.py), answer futures, access-log each."""
+        now = time.monotonic()
+        live = []
+        for req, fut in group:
+            if req.expired(now):
+                err = schema.RequestTimeoutError(
+                    f"timed out after {req.timeout_s:.3f}s in queue"
+                )
+                with self._lock:
+                    self._stats["timeouts"] += 1
+                    self._depth -= 1
+                obs.record_run(err.to_response(req.req_id), req.cfg)
+                fut._set(err.to_response(req.req_id))
+            else:
+                live.append((req, fut))
+        if not live:
+            return
+        results = dispatch.run_batch([r for r, _ in live], self.max_batch)
+        degraded = any(
+            resp.get("batch", {}).get("degraded") for _, resp in results
+        )
+        with self._lock:
+            self._stats["batches"] += 1
+            if degraded:
+                self._stats["degraded_batches"] += 1
+            b = len(live)
+            self._occupancy[b] = self._occupancy.get(b, 0) + 1
+        # run_batch answers in submission order, one response per request
+        for (req, fut), (_, resp) in zip(live, results):
+            with self._lock:
+                self._depth -= 1
+                if resp.get("status") == "ok":
+                    self._stats["served"] += 1
+                else:
+                    self._stats["errors"] += 1
+            obs.record_run(resp, req.cfg)
+            fut._set(resp)
+
+    # ---------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        """The /stats endpoint body: serving counters, batch-occupancy
+        histogram, admission state, knobs, and the executable-registry
+        snapshot (utils/aotcache.stats_snapshot — the satellite contract)."""
+        with self._lock:
+            rec = {
+                **{k: (dict(v) if isinstance(v, dict) else v)
+                   for k, v in self._stats.items()},
+                "queue_depth": self._depth,
+                "occupancy": {str(k): v for k, v in
+                              sorted(self._occupancy.items())},
+                "paused": self.paused,
+                "health": dict(self._health),
+                "closing": self._closing,
+                "knobs": {
+                    "max_batch": self.max_batch,
+                    "max_wait_ms": self.max_wait_ms,
+                    "max_queue": self.max_queue,
+                    "default_timeout_s": self.default_timeout_s,
+                },
+            }
+        rec["cache"] = aotcache.registry.stats_snapshot()
+        return rec
+
+    # -------------------------------------------------------------- prewarm
+    def prewarm(self, obj: dict) -> dict:
+        """Compile (or load from the persistent AOT cache) every executable
+        a request template's batch group can dispatch to — the solo program
+        plus each power-of-two bucket up to ``max_batch`` — so steady-state
+        traffic never pays an inline compile.  Returns the per-bucket wall
+        seconds (the daemon's ``--prewarm`` and the bench's cold phase)."""
+        req = schema.parse_request(
+            dict(obj), "prewarm", default_timeout_s=self.default_timeout_s
+        )
+        walls = {}
+        sizes = [1]
+        b = 2
+        while b <= self.max_batch:
+            sizes.append(b)
+            b *= 2
+        if sizes[-1] != self.max_batch:
+            # non-power-of-two max_batch: bucket_size caps at max_batch,
+            # so that capped bucket is dispatchable too and must be warm
+            sizes.append(self.max_batch)
+        for size in sizes:
+            reqs = []
+            for i in range(size):
+                r = schema.parse_request(
+                    dict(obj), f"prewarm-{size}-{i}",
+                    default_timeout_s=self.default_timeout_s,
+                )
+                r.seed = i
+                r.submitted = time.monotonic()
+                reqs.append(r)
+            t0 = time.monotonic()
+            results = dispatch.run_batch(reqs, self.max_batch)
+            walls[str(size)] = round(time.monotonic() - t0, 3)
+            for _, resp in results:
+                if resp.get("status") != "ok":
+                    raise schema.ServeError(
+                        f"prewarm dispatch failed at bucket {size}: "
+                        f"{resp.get('error')}"
+                    )
+        return walls

@@ -184,6 +184,20 @@ class ExecutableRegistry:
                 "persistent_dir": persistent_dir(),
             }
 
+    def stats_snapshot(self) -> dict:
+        """Thread-safe point-in-time snapshot for stats endpoints: the full
+        :meth:`stats` record plus a per-factory entry breakdown.  The
+        scenario server (serve/) attaches this to its ``/stats`` endpoint
+        and its bench/self-test manifests so a running daemon's cache state
+        is inspectable without touching jax (pure counter reads)."""
+        with self._lock:
+            by_factory: dict[str, int] = {}
+            for key in self._entries:
+                by_factory[key[0]] = by_factory.get(key[0], 0) + 1
+            snap = self.stats()  # RLock: safe to re-enter
+            snap["by_factory"] = dict(sorted(by_factory.items()))
+            return snap
+
     def manifest(self) -> dict:
         """The compact ``cache`` block utils/obs.py attaches to every
         runs.jsonl line.  Pure counter reads — never touches jax."""

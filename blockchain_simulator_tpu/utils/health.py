@@ -162,6 +162,30 @@ def probe_backend_supervised(patience_s: float = 120.0, env=None) -> dict:
     return rec
 
 
+def latest_verdict(path: str | None = None) -> dict | None:
+    """Most recent verdict record from a rolling health log (explicit path,
+    else ``$BLOCKSIM_HEALTH_JSONL``), or None when no log / no parseable
+    verdict line exists.  Read-only and never raises: the scenario server
+    (serve/) consults this at startup to decide whether admission opens
+    paused — a stale or missing log must default to serving, not crash."""
+    path = path or os.environ.get(HEALTH_ENV)
+    if not path:
+        return None
+    last = None
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and rec.get("verdict") in VERDICTS:
+                    last = rec
+    except OSError:
+        return None
+    return last
+
+
 def append_health(rec: dict, path: str | None = None) -> None:
     """Append one verdict line to the rolling health log.  Path precedence:
     explicit arg, $BLOCKSIM_HEALTH_JSONL, nothing (no-op — resolved here so

@@ -113,6 +113,18 @@ def manifest(cfg=None, backend=None, device_count=None) -> dict:
     return rec
 
 
+def percentile(xs, q: float) -> float:
+    """Nearest-rank percentile on a sorted copy — THE percentile every
+    latency surface shares (serve self-test, tools/serve_bench.py), so the
+    gated ``*_p99_ms`` trajectories are computed one way.  No numpy: the
+    callers include daemon control paths that must not touch a backend."""
+    xs = sorted(xs)
+    if not xs:
+        return 0.0
+    idx = min(len(xs) - 1, max(0, int(round(q / 100.0 * (len(xs) - 1)))))
+    return xs[idx]
+
+
 def rounds_per_s(rounds, run_s) -> float | None:
     """THE uniform throughput computation: completed consensus rounds over
     the measured execution-only wall (never the compile-inclusive first

@@ -186,6 +186,42 @@ def test_bench_compare_never_gates_graph_cost_trajectories(tmp_path):
     assert "graph_sim_pbft_tick_gflops" in proc.stdout
 
 
+def test_bench_compare_gates_p99_latency_inverted(tmp_path):
+    """serve_p99_ms is lower-is-better AND gated: an increase beyond the
+    threshold is the regression; a decrease (faster serving) never trips."""
+    runs = tmp_path / "runs.jsonl"
+
+    def write(vals):
+        runs.write_text("".join(
+            json.dumps({"metric": "serve_p99_ms", "value": v,
+                        "manifest": {"obs_schema": 1}}) + "\n"
+            for v in vals))
+
+    write([100.0, 350.0])  # 3.5x slower: beyond the 50% threshold
+    proc = _run([str(BENCH_COMPARE), _bench_artifact(tmp_path, 1, 100.0),
+                 "--runs", str(runs)])
+    assert proc.returncode == 1
+    assert "REGRESSION: serve_p99_ms" in proc.stdout
+    write([350.0, 100.0])  # got faster: charted, never gated
+    proc = _run([str(BENCH_COMPARE), _bench_artifact(tmp_path, 1, 100.0),
+                 "--runs", str(runs)])
+    assert proc.returncode == 0, proc.stdout
+
+
+def test_bench_compare_never_gates_p50_latency(tmp_path):
+    """The median moves with the max_wait batching knob by design: charted
+    only (UNGATED_SUFFIXES), in either direction."""
+    runs = tmp_path / "runs.jsonl"
+    runs.write_text("".join(
+        json.dumps({"metric": "serve_p50_ms", "value": v,
+                    "manifest": {"obs_schema": 1}}) + "\n"
+        for v in (10.0, 500.0)))
+    proc = _run([str(BENCH_COMPARE), _bench_artifact(tmp_path, 1, 100.0),
+                 "--runs", str(runs)])
+    assert proc.returncode == 0, proc.stdout
+    assert "serve_p50_ms" in proc.stdout
+
+
 def test_bench_compare_unparseable_artifact_exits_2(tmp_path):
     bad = tmp_path / "BENCH_r09.json"
     bad.write_text("{not json")
@@ -207,16 +243,21 @@ def test_lint_sh_chains_both_gates(tmp_path):
         # down — the chain itself is covered by test_warm_bench_script_*
         # (tests/test_zsweep_cache.py); this smoke pins the lint+compare
         # gates.  GRAPH=0: the IR audit traces every factory (~1.5 min) —
-        # its gate is covered end-to-end by tests/test_zzgraph.py
+        # its gate is covered end-to-end by tests/test_zzgraph.py.
+        # SERVE=0: the serving smoke compiles a daemon's worth of
+        # executables — covered by tests/test_zserve.py's self-test.
         env={**os.environ, "BLOCKSIM_RUNS_JSONL": str(runs),
-             "WARM_BENCH": "0", "GRAPH": "0"},
+             "WARM_BENCH": "0", "GRAPH": "0", "SERVE": "0"},
     )
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
     assert "jaxlint" in proc.stdout and "no regression" in proc.stdout
-    # the jaxgraph stage is chained (and skippable) — pin the script contract
+    # the jaxgraph and serve stages are chained (and skippable) — pin the
+    # script contract
     script = (REPO / "tools" / "lint.sh").read_text()
     assert "blockchain_simulator_tpu.lint.graph" in script
     assert '"${GRAPH:-1}"' in script
+    assert "blockchain_simulator_tpu.serve --self-test" in script
+    assert '"${SERVE:-1}"' in script
     recs = [json.loads(ln) for ln in runs.read_text().strip().splitlines()]
     lint_recs = [r for r in recs if r.get("metric") == "jaxlint_new_findings"]
     assert lint_recs and lint_recs[-1]["value"] == 0

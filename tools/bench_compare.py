@@ -127,8 +127,16 @@ def trajectory(rows: list[dict]) -> dict[str, list[dict]]:
 # budget gate — chart, never gate.  Keyed on the "graph_" PREFIX, not the
 # unit suffixes: a future bench metric like "peak_rss_bytes", where a drop
 # IS meaningful, must stay under the throughput rule.
-UNGATED_SUFFIXES = ("_findings", "_compile_s")
+UNGATED_SUFFIXES = ("_findings", "_compile_s", "_p50_ms")
 UNGATED_PREFIXES = ("graph_",)
+
+# Serving latency is lower-is-better AND gated: the serve smoke/bench land
+# a p99 trajectory (serve_p99_ms) whose REGRESSION is an increase, so the
+# gate inverts for these suffixes — last > (1 + threshold) * prev fails.
+# p50 is charted only (the _p50_ms carve-out above): the median moves with
+# the max_wait batching knob by design, while a p99 blow-up means the
+# serving path itself got slower (KNOWN_ISSUES "batching/latency").
+LOWER_IS_BETTER_SUFFIXES = ("_p99_ms",)
 
 
 def compile_s_rows(rows: list[dict]) -> list[dict]:
@@ -145,7 +153,8 @@ def compile_s_rows(rows: list[dict]) -> list[dict]:
 
 def check_regressions(by_metric: dict, threshold: float) -> list[str]:
     """Newest numeric value vs its predecessor, per metric: regressed when
-    ``last < (1 - threshold) * prev``."""
+    ``last < (1 - threshold) * prev`` — inverted for the lower-is-better
+    latency suffixes (``last > (1 + threshold) * prev``)."""
     failures = []
     for metric, rows in by_metric.items():
         if metric.endswith(UNGATED_SUFFIXES) \
@@ -155,6 +164,14 @@ def check_regressions(by_metric: dict, threshold: float) -> list[str]:
         if len(vals) < 2:
             continue
         prev, last = vals[-2], vals[-1]
+        if metric.endswith(LOWER_IS_BETTER_SUFFIXES):
+            if prev > 0 and last > (1.0 + threshold) * prev:
+                failures.append(
+                    f"{metric}: {last} vs previous {prev} "
+                    f"({last / prev:.1%} of prior; lower-is-better "
+                    f"threshold {1 + threshold:.0%})"
+                )
+            continue
         if prev > 0 and last < (1.0 - threshold) * prev:
             failures.append(
                 f"{metric}: {last} vs previous {prev} "

@@ -10,6 +10,8 @@
 #     every registered executable factory and reports zero non-baselined IR
 #     findings / budget regressions (GRAPH=0 skips — it costs ~1.5 min of
 #     tracing on the 2-core box);
+#   - the serving smoke (`python -m blockchain_simulator_tpu.serve
+#     --self-test`) drives the daemon over real HTTP (SERVE=0 skips);
 #   - `tools/bench_compare.py` sees no metric drop beyond its threshold.
 #
 # When $BLOCKSIM_RUNS_JSONL is set the lint runs themselves land in
@@ -45,6 +47,21 @@ if [ "${GRAPH:-1}" != "0" ]; then
     graph_rc=$?
     if [ "$graph_rc" -ne 0 ]; then
         echo "lint.sh: jaxgraph FAILED (rc=$graph_rc)" >&2
+        rc=1
+    fi
+fi
+
+# Serving smoke (serve/__main__.py --self-test): ephemeral daemon on the
+# CPU backend, a batch/reject/health drill over real HTTP, one JSON summary
+# line; lands serve_rps / serve_p99_ms in runs.jsonl when set (p99 is gated
+# lower-is-better by bench_compare).  SERVE=0 skips (~30 s of compile on
+# the 2-core box); tests/test_zserve.py covers the self-test end to end.
+if [ "${SERVE:-1}" != "0" ]; then
+    echo "== serve smoke =="
+    python -m blockchain_simulator_tpu.serve --self-test
+    serve_rc=$?
+    if [ "$serve_rc" -ne 0 ]; then
+        echo "lint.sh: serve smoke FAILED (rc=$serve_rc)" >&2
         rc=1
     fi
 fi

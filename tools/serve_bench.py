@@ -1,0 +1,278 @@
+"""ARTIFACT_serve_bench.json generator: the repo's first sustained-traffic
+number — requests/s and p50/p99 latency through the scenario server.
+
+The acceptance measurement of the serving subsystem (serve/):
+
+- **cold vs warm split**: the per-bucket prewarm walls (compile-inclusive)
+  vs the steady-state phases, where every dispatch answers from the warm
+  executable registry (asserted: zero registry misses during the phases);
+- **micro-batching**: two open-loop synthetic phases (fixed arrival rate,
+  submissions never wait for responses) — a *capacity* phase overdriven
+  past this box's service rate, whose measured throughput is the sustained
+  requests/s and whose occupancy histogram shows requests coalescing into
+  vmapped dispatches, then a *latency* phase below capacity, whose p50/p99
+  measure the serving path (max_wait + dispatch) rather than queue depth;
+- **bit-equality**: >= 2 requests served from a SINGLE vmapped dispatch
+  are re-run solo (``runner.run_simulation`` at the static config) and
+  must match bit-for-bit (``stat_sampler="exact"`` pinned — the
+  parallel/sweep.py caveat);
+- **fault drill**: the daemon survives an un-batchable request (typed
+  422), queue overflow (429 backpressure, rejection recorded), and a
+  sick->healthy health-verdict cycle (503 pause, then served).
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/serve_bench.py [--rate 50] [--requests 200]
+
+Writes ARTIFACT_serve_bench.json and (when $BLOCKSIM_RUNS_JSONL is set)
+lands ``serve_bench_rps`` / ``serve_bench_p99_ms`` / ``serve_bench_p50_ms``
+trajectory rows — names distinct from the self-test's ``serve_*`` series,
+so each gated ``_p99_ms`` trajectory compares against its own workload.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys as _sys
+import threading
+import time
+
+_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ARTIFACT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "ARTIFACT_serve_bench.json",
+)
+
+
+def _norm(m: dict) -> dict:
+    return {k: str(v) for k, v in m.items()}
+
+
+def run_drill() -> dict:
+    """The fault drill at toy scale (n=8): typed rejection, backpressure,
+    admission pause/resume — every leg must leave the server serving."""
+    from blockchain_simulator_tpu.serve import ScenarioServer, ServeError
+
+    tpl = {"protocol": "pbft", "n": 8, "sim_ms": 200, "stat_sampler": "exact"}
+    drill = {}
+    with ScenarioServer(max_batch=2, max_wait_ms=5.0) as srv:
+        r = srv.request(dict(tpl, protocol="mixed", n=32))
+        drill["unbatchable_code"] = r.get("code")
+        drill["unbatchable_kind"] = r.get("kind")
+        srv.set_health("sick")
+        drill["paused_code"] = srv.request(dict(tpl, seed=1)).get("code")
+        srv.set_health("healthy")
+        drill["resumed_code"] = srv.request(dict(tpl, seed=1)).get("code")
+    # backpressure needs a stalled batcher: build unstarted, fill, overflow
+    srv = ScenarioServer(max_batch=2, max_wait_ms=5.0, max_queue=1,
+                         start=False)
+    srv.submit(dict(tpl, seed=2))
+    try:
+        srv.submit(dict(tpl, seed=3))
+        drill["backpressure_code"] = None
+    except ServeError as e:
+        drill["backpressure_code"] = e.code
+        drill["backpressure_kind"] = e.kind
+    srv.start()
+    srv.close()
+    drill["ok"] = (
+        drill.get("unbatchable_code") == 422
+        and drill.get("paused_code") == 503
+        and drill.get("resumed_code") == 200
+        and drill.get("backpressure_code") == 429
+    )
+    return drill
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="serve_bench")
+    p.add_argument("--n", type=int, default=1024, help="cluster size")
+    p.add_argument("--sim-ms", type=int, default=600)
+    p.add_argument("--rate", type=float, default=50.0,
+                   help="capacity-phase arrival rate (requests/s; above "
+                        "this box's capacity on purpose — the measured "
+                        "throughput IS the sustained number)")
+    p.add_argument("--requests", type=int, default=200,
+                   help="capacity-phase request count")
+    p.add_argument("--latency-rate", type=float, default=8.0,
+                   help="latency-phase arrival rate (below capacity: the "
+                        "p50/p99 here measure the serving path, not queue "
+                        "depth)")
+    p.add_argument("--latency-requests", type=int, default=60)
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-wait-ms", type=float, default=10.0)
+    args = p.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from blockchain_simulator_tpu.runner import run_simulation
+    from blockchain_simulator_tpu.serve import ScenarioServer
+    from blockchain_simulator_tpu.utils import aotcache, obs
+    from blockchain_simulator_tpu.utils.config import FaultConfig, SimConfig
+
+    # the round-blocked fast path at a mid scale: the workload where warm
+    # serving shines (ms of simulation behind s of one-time compile).
+    # exact sampler pinned: the bit-equality leg compares batched vs solo
+    # static runs (the parallel/sweep.py float-path caveat).
+    template = {
+        "protocol": "pbft", "n": args.n, "sim_ms": args.sim_ms,
+        "delivery": "stat", "schedule": "round",
+        "model_serialization": False, "stat_sampler": "exact",
+        "pbft_window": 8, "pbft_max_slots": 48,
+    }
+    f_levels = [0, 1, 2, 5, 10]  # same structure: one executable per bucket
+
+    server = ScenarioServer(
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        max_queue=max(4 * args.max_batch, args.requests),
+    )
+
+    # ---- cold phase: compile every bucket of the batch group ------------
+    t0 = time.monotonic()
+    prewarm_walls = server.prewarm(template)
+    cold_s = time.monotonic() - t0
+
+    # ---- bit-equality pin: one synchronized pair, one vmapped dispatch --
+    pair_srv_reqs = [
+        dict(template, seed=11, faults={"n_byzantine": 0}),
+        dict(template, seed=12, faults={"n_byzantine": 5}),
+    ]
+    with ScenarioServer(max_batch=2, max_wait_ms=2000.0) as pair_srv:
+        pends = [pair_srv.submit(r) for r in pair_srv_reqs]
+        pair = [pd.result(300) for pd in pends]
+    batched_pair = all(
+        r.get("status") == "ok" and r["batch"]["size"] >= 2
+        and r["batch"]["mode"] == "batched" for r in pair
+    )
+    bit_equal = batched_pair
+    if batched_pair:
+        for req, resp in zip(pair_srv_reqs, pair):
+            cfg = SimConfig(
+                **{k: v for k, v in req.items()
+                   if k not in ("faults", "seed")},
+                seed=req["seed"],
+                faults=FaultConfig(**req.get("faults", {})),
+            )
+            solo = run_simulation(cfg, seed=req["seed"])
+            bit_equal = bit_equal and _norm(solo) == _norm(resp["metrics"])
+
+    # ---- warm phases: open-loop traffic against warm executables --------
+    def open_loop(rate, count, seed0):
+        pending = []
+        interval = 1.0 / rate if rate > 0 else 0.0
+
+        def feed():
+            for i in range(count):
+                obj = dict(
+                    template,
+                    seed=seed0 + i,
+                    faults={"n_byzantine": f_levels[i % len(f_levels)]},
+                )
+                try:
+                    pending.append(server.submit(obj))
+                except Exception:
+                    pending.append(None)  # counted as a lost lane below
+                time.sleep(interval)
+
+        t = time.monotonic()
+        feeder = threading.Thread(target=feed)
+        feeder.start()
+        feeder.join()
+        responses = [pd.result(600) for pd in pending if pd is not None]
+        return responses, time.monotonic() - t
+
+    s_before = aotcache.registry.stats()
+    # capacity: overdrive the queue — measured throughput IS the sustained
+    # requests/s of this box (batches run back to back)
+    cap_responses, cap_wall = open_loop(args.rate, args.requests, 1000)
+    occupancy_cap = server.stats()["occupancy"]
+    # latency: below capacity — p50/p99 measure the serving path
+    # (max_wait + dispatch), not open-loop queue depth
+    lat_responses, _lat_wall = open_loop(
+        args.latency_rate, args.latency_requests, 5000)
+    s_after = aotcache.registry.stats()
+
+    ok = [r for r in cap_responses if r.get("status") == "ok"]
+    lat_ok = [r for r in lat_responses if r.get("status") == "ok"]
+    lat = [r["latency_ms"] for r in lat_ok]
+    stats = server.stats()
+    server.close()
+
+    drill = run_drill()
+
+    rps = round(len(ok) / cap_wall, 2) if cap_wall > 0 else None
+    p50 = round(obs.percentile(lat, 50), 3)
+    p99 = round(obs.percentile(lat, 99), 3)
+    batched_served = sum(1 for r in ok if r["batch"]["size"] >= 2)
+    rec = {
+        "metric": "serve_bench_rps",
+        "value": rps,
+        "unit": "req/s",
+        "config": {k: template[k] for k in
+                   ("protocol", "n", "sim_ms", "schedule")},
+        "workload": {
+            "capacity_rate_rps": args.rate, "requests": args.requests,
+            "latency_rate_rps": args.latency_rate,
+            "latency_requests": args.latency_requests,
+            "f_levels": f_levels, "max_batch": args.max_batch,
+            "max_wait_ms": args.max_wait_ms,
+        },
+        "cold": {"prewarm_bucket_s": prewarm_walls,
+                 "total_s": round(cold_s, 2)},
+        "warm": {
+            "capacity_wall_s": round(cap_wall, 2),
+            "served": len(ok),
+            "errors": len(cap_responses) - len(ok),
+            "rps": rps,
+            "latency_served": len(lat_ok),
+            "p50_ms": p50,
+            "p99_ms": p99,
+            "overload_p50_ms": round(obs.percentile(
+                [r["latency_ms"] for r in ok], 50), 3),
+            "overload_p99_ms": round(obs.percentile(
+                [r["latency_ms"] for r in ok], 99), 3),
+            "batched_served": batched_served,
+            "occupancy_capacity_phase": occupancy_cap,
+            "occupancy": stats["occupancy"],
+            "registry_misses_during_phase":
+                s_after["misses"] - s_before["misses"],
+        },
+        "bit_equality": {
+            "pair_batched_one_dispatch": batched_pair,
+            "pair_bit_equal_vs_solo": bit_equal,
+        },
+        "drill": drill,
+        "registry": aotcache.registry.stats_snapshot(),
+    }
+    with open(ARTIFACT, "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    print(json.dumps(obs.finalize(dict(rec), None, append=False)))
+    # serve_bench_* names, NOT the self-test's serve_* series: the two
+    # measure different workloads (n=1024 batched vs n=8 solo smoke) and
+    # bench_compare gates each _p99_ms trajectory against its own history
+    obs.finalize({"metric": "serve_bench_rps", "value": rps,
+                  "unit": "req/s"})
+    obs.finalize({"metric": "serve_bench_p99_ms", "value": p99,
+                  "unit": "ms"})
+    obs.finalize({"metric": "serve_bench_p50_ms", "value": p50,
+                  "unit": "ms"})
+    accept = (
+        batched_pair and bit_equal and drill["ok"]
+        and len(ok) == args.requests
+        and len(lat_ok) == args.latency_requests
+        and rec["warm"]["registry_misses_during_phase"] == 0
+    )
+    if not accept:
+        print(f"serve_bench: ACCEPTANCE NOT MET (pair={batched_pair}, "
+              f"bit_equal={bit_equal}, drill={drill['ok']}, "
+              f"served={len(ok)}/{args.requests})")
+    return 0 if accept else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,37 @@
+"""Deterministic fault injection for the serving stack itself.
+
+The repo simulates Byzantine and crash faults *inside* the consensus
+models; this package applies the same discipline to the framework around
+them — the scenario server, the batched dispatch primitive, the
+persistent compile cache, the health gate.  Production code carries
+named chaos points (:func:`~blockchain_simulator_tpu.chaos.inject.
+chaos_point`) that are free when disarmed; a seeded
+:class:`~blockchain_simulator_tpu.chaos.inject.ChaosController` arms
+them with counted, reproducible faults (raise, hang, slow, poison), and
+:mod:`~blockchain_simulator_tpu.chaos.invariants` checks that the stack
+kept its accounting promises while the faults flew:
+
+- **no request unaccounted** — every admission ends in exactly one of
+  {response, typed rejection, replayed};
+- **no lost manifest lines** — every terminal outcome has its access-log
+  line in runs.jsonl;
+- **registry stats monotone** — cache counters never run backwards.
+
+``tools/chaos_drill.py`` scripts the scenarios (dispatch-fail/hang,
+cache-corrupt, health-flap, batcher-kill, queue-storm, poison-request,
+crash-restart) and pins that each runs identically twice under one chaos
+seed; README "Chaos drills" is the operator doc.
+"""
+
+from blockchain_simulator_tpu.chaos.inject import (  # noqa: F401
+    ChaosController,
+    ChaosFault,
+    ChaosKill,
+    chaos_point,
+    controller,
+)
+from blockchain_simulator_tpu.chaos.invariants import (  # noqa: F401
+    Ledger,
+    check_server,
+    registry_monotone,
+)

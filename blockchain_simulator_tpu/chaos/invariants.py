@@ -1,0 +1,162 @@
+"""The accounting contracts a chaos scenario must not break.
+
+The serving stack's promise (serve/server.py) is *no silent drop*: every
+request that enters ``submit`` leaves through exactly one typed door —
+a success response, a typed rejection, or (after a crash) a WAL replay —
+and every door writes an access-log line.  Faults are allowed to change
+WHICH door; they are never allowed to lose a request or a line.  This
+module turns that promise into a checkable function the drill
+(tools/chaos_drill.py) and the tests run after every scenario:
+
+1. **No request unaccounted** — each submitted request id observed
+   exactly one terminal outcome client-side, and the server's own
+   counters balance: ``received + replayed == served + errors + timeouts
+   + Σ rejected`` with the queue drained (``queue_depth == 0``).
+2. **No lost manifest lines** — every terminal outcome (including each
+   replayed request) has at least one runs.jsonl record carrying its id.
+3. **Registry stats monotone** — executable-registry counters
+   (hits/misses/evictions/disk_*/corrupt_healed) never decrease across
+   the scenario: a fault may add misses or heals, it may not rewind
+   history.
+
+Violations are returned as human-readable strings (empty list = clean);
+the drill sums them into the ``chaos_invariant_violations`` metric.
+"""
+
+from __future__ import annotations
+
+from blockchain_simulator_tpu.utils import obs
+
+# Counters that must never decrease across a scenario (invariant 3).
+MONOTONE_KEYS = (
+    "hits", "misses", "evictions", "disk_hits", "disk_misses",
+    "disk_saves", "disk_errors", "corrupt_healed",
+)
+
+
+class Ledger:
+    """Client-side record of every submission a scenario made: one
+    *attempt* per ``submitted()`` call (the same id may legitimately be
+    submitted twice — a client retry, or a poison resubmission), one
+    terminal outcome filled per attempt as responses land.  The checker
+    demands exactly one outcome per attempt — zero means a lost request,
+    two means a double answer."""
+
+    def __init__(self):
+        # id -> one outcome list per submission attempt, oldest first
+        self.attempts: dict[str, list[list[str]]] = {}
+
+    def submitted(self, req_id: str) -> None:
+        self.attempts.setdefault(str(req_id), []).append([])
+
+    def record(self, req_id: str, response: dict) -> None:
+        """Record the uniform response body (ok or typed error) against
+        the oldest still-unanswered attempt of this id; a surplus answer
+        piles onto the newest attempt, which the checker flags."""
+        kind = "ok" if response.get("status") == "ok" \
+            else str(response.get("kind"))
+        slots = self.attempts.setdefault(str(req_id), [[]])
+        for slot in slots:
+            if not slot:
+                slot.append(kind)
+                return
+        slots[-1].append(kind)
+
+    def record_error(self, req_id: str, err: Exception) -> None:
+        """Record a typed ServeError raised by ``submit``."""
+        self.record(str(req_id), {
+            "status": "error", "kind": getattr(err, "kind", "internal-error"),
+        })
+
+    def kinds(self) -> dict[str, list[str]]:
+        """id -> outcome kinds across attempts in submission order, for
+        the drill's determinism comparison."""
+        return {
+            k: [kind for slot in v for kind in slot]
+            for k, v in sorted(self.attempts.items())
+        }
+
+
+def registry_monotone(before: dict, after: dict) -> list[str]:
+    """Invariant 3 on two aotcache stats snapshots."""
+    violations = []
+    for key in MONOTONE_KEYS:
+        b, a = before.get(key, 0) or 0, after.get(key, 0) or 0
+        if a < b:
+            violations.append(
+                f"registry counter {key!r} ran backwards: {b} -> {a}"
+            )
+    return violations
+
+
+def _stats_balance(stats: dict) -> list[str]:
+    """Invariant 1, server side: the terminal counters cover every
+    admission (fresh and replayed) with nothing left in the queue."""
+    violations = []
+    depth = stats.get("queue_depth", 0)
+    if depth != 0:
+        violations.append(f"queue_depth {depth} != 0 after quiescence")
+    entered = stats.get("received", 0) + stats.get("replayed", 0)
+    left = (
+        stats.get("served", 0) + stats.get("errors", 0)
+        + stats.get("timeouts", 0)
+        + sum((stats.get("rejected") or {}).values())
+    )
+    if entered != left:
+        violations.append(
+            f"request accounting broken: received+replayed={entered} but "
+            f"served+errors+timeouts+rejected={left} "
+            f"(stats: { {k: stats.get(k) for k in ('received', 'replayed', 'served', 'errors', 'timeouts', 'rejected')} })"
+        )
+    return violations
+
+
+def check_server(
+    ledger: Ledger | None,
+    stats: dict,
+    log_path=None,
+    registry_before: dict | None = None,
+    registry_after: dict | None = None,
+    replayed_ids=(),
+) -> list[str]:
+    """Run every invariant a scenario can supply evidence for; returns the
+    violation list (empty = clean).
+
+    ``ledger`` — the scenario's client-side submissions (None skips 1a);
+    ``stats`` — ``ScenarioServer.stats()`` at quiescence;
+    ``log_path`` — the scenario's runs.jsonl access log (None skips 2);
+    ``registry_before/after`` — aotcache snapshots bracketing the run;
+    ``replayed_ids`` — ids the scenario expects WAL replay to answer.
+    """
+    violations: list[str] = []
+    if ledger is not None:
+        for req_id, attempts in ledger.attempts.items():
+            for i, slot in enumerate(attempts):
+                if len(slot) != 1:
+                    violations.append(
+                        f"request {req_id!r} attempt {i} has {len(slot)} "
+                        f"terminal outcomes {slot} (exactly one required)"
+                    )
+    violations += _stats_balance(stats)
+    if log_path is not None:
+        recs = obs.read_jsonl(log_path)
+        logged = {str(r.get("id")) for r in recs if r.get("id") is not None}
+        replay_logged = {
+            str(r.get("id")) for r in recs if r.get("replayed") is True
+        }
+        if ledger is not None:
+            for req_id in ledger.attempts:
+                if req_id not in logged:
+                    violations.append(
+                        f"request {req_id!r} has no access-log line "
+                        f"(manifest lost)"
+                    )
+        for req_id in replayed_ids:
+            if str(req_id) not in replay_logged:
+                violations.append(
+                    f"replayed request {req_id!r} has no replayed "
+                    f"access-log line"
+                )
+    if registry_before is not None and registry_after is not None:
+        violations += registry_monotone(registry_before, registry_after)
+    return violations

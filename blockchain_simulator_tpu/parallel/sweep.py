@@ -40,6 +40,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
+from blockchain_simulator_tpu.chaos import inject
 from blockchain_simulator_tpu.models.base import canonical_fault_cfg, get_protocol
 from blockchain_simulator_tpu.parallel.mesh import SWEEP_AXIS
 from blockchain_simulator_tpu.runner import (
@@ -140,6 +141,10 @@ def run_dyn_points(canon: SimConfig, points, record: bool = True,
     points (the server's bucket-padded lanes are duplicates whose metrics
     would be discarded)."""
     points = list(points)
+    # the batched-dispatch chaos point: the drills inject raise/hang/slow
+    # here — the exact exception path a real backend fault takes through
+    # the sweeps AND the serving degrade machinery (chaos/inject.py)
+    inject.chaos_point("sweep.dyn_dispatch", canon=canon, n=len(points))
     keys = jax.vmap(jax.random.key)(
         jnp.asarray([s for _, s in points], jnp.uint32)
     )

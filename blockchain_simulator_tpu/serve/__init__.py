@@ -8,6 +8,7 @@ serving" for the request schema and knobs.
 
 from blockchain_simulator_tpu.serve.schema import (  # noqa: F401
     AdmissionPausedError,
+    DispatchFailedError,
     InvalidRequestError,
     QueueFullError,
     RequestTimeoutError,
@@ -18,6 +19,8 @@ from blockchain_simulator_tpu.serve.schema import (  # noqa: F401
     parse_request,
 )
 from blockchain_simulator_tpu.serve.server import (  # noqa: F401
+    CircuitBreaker,
     PendingResponse,
     ScenarioServer,
 )
+from blockchain_simulator_tpu.serve.wal import WriteAheadLog  # noqa: F401

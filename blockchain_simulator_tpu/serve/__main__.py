@@ -14,7 +14,15 @@ server.ScenarioServer`:
   to pause/resume admission (the drill's lever; utils/health.py's CLI
   writes the rolling log the server can also seed from via
   ``--health-log``).
-- ``POST /shutdown`` — graceful drain and exit.
+- ``POST /shutdown`` — graceful drain and exit (body ``{"drain": false}``
+  answers the queued backlog with typed 503 rejections instead of
+  dispatching it — fast shutdown, nothing stranded).
+
+With ``--wal PATH`` admitted requests are journaled durably
+(serve/wal.py): a daemon killed mid-traffic replays every
+admitted-but-unanswered request exactly once per pending id on restart
+(the READY line reports the replay count; tools/chaos_drill.py drills
+it with a real kill -9).
 
 The daemon prints exactly one ``READY {...}`` JSON line (with the bound
 port) once serving, so drivers on an ephemeral ``--port 0`` can find it.
@@ -127,7 +135,14 @@ def make_httpd(server, host: str = "127.0.0.1", port: int = 0):
                 self._send(200, {"status": "ok", "health": rec,
                                  "paused": server.paused})
             elif self.path == "/shutdown":
-                self._send(200, {"status": "ok", "draining": True})
+                obj = self._read_json()
+                drain = True
+                if isinstance(obj, dict) and obj.get("drain") is False:
+                    # fast shutdown: queued requests answer as typed 503s
+                    # with rejection manifests instead of dispatching
+                    drain = False
+                    server._drain = False
+                self._send(200, {"status": "ok", "draining": drain})
                 threading.Thread(target=httpd.shutdown,
                                  daemon=True).start()
             else:
@@ -283,6 +298,21 @@ def main(argv=None) -> int:
     p.add_argument("--health-log", default=None,
                    help="seed the admission gate from this rolling "
                         "HEALTH.jsonl (utils/health.py)")
+    p.add_argument("--wal", default=None, metavar="PATH",
+                   help="crash-durable write-ahead log of admitted "
+                        "requests (serve/wal.py): a restarted daemon "
+                        "replays admitted-but-unanswered requests exactly "
+                        "once per pending id")
+    p.add_argument("--wal-no-sync", action="store_true",
+                   help="skip the per-admit fsync (faster admission, "
+                        "admits may be lost to an OS crash — process "
+                        "kills still replay)")
+    p.add_argument("--breaker-threshold", type=int, default=3,
+                   help="consecutive batched-dispatch failures before a "
+                        "group's circuit breaker opens (solo-only mode)")
+    p.add_argument("--breaker-cooldown-s", type=float, default=30.0,
+                   help="seconds an open breaker waits before its "
+                        "half-open probe batch")
     p.add_argument("--prewarm", default=None, metavar="JSON",
                    help="request template whose batch group is compiled "
                         "(or AOT-cache-loaded) across every bucket size "
@@ -314,6 +344,10 @@ def main(argv=None) -> int:
         max_queue=args.max_queue,
         default_timeout_s=args.timeout_s,
         health_log=args.health_log,
+        wal_path=args.wal,
+        wal_sync=not args.wal_no_sync,
+        breaker_threshold=args.breaker_threshold,
+        breaker_cooldown_s=args.breaker_cooldown_s,
     )
     if args.prewarm:
         try:
@@ -326,7 +360,8 @@ def main(argv=None) -> int:
     print("READY " + json.dumps({
         "host": args.host, "port": httpd.server_address[1],
         "max_batch": server.max_batch, "max_wait_ms": server.max_wait_ms,
-        "max_queue": server.max_queue,
+        "max_queue": server.max_queue, "wal": args.wal,
+        "replayed": server._wal_replayed_at_start if args.wal else 0,
     }), flush=True)
     try:
         httpd.serve_forever()

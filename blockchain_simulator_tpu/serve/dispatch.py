@@ -37,6 +37,7 @@ import time
 import jax
 import jax.numpy as jnp
 
+from blockchain_simulator_tpu.chaos import inject
 from blockchain_simulator_tpu.models.base import get_protocol
 from blockchain_simulator_tpu.runner import make_dyn_sim_fn
 from blockchain_simulator_tpu.serve import schema
@@ -76,7 +77,10 @@ def _operands(reqs):
 
 
 def _solo_metrics(req):
-    """Run one request through the solo executable; returns its metrics."""
+    """Run one request through the solo executable; returns its metrics.
+    The ``serve.solo_dispatch`` chaos point fires first with the request
+    id, so a drill can poison exactly one request (chaos/inject.py)."""
+    inject.chaos_point("serve.solo_dispatch", req_id=req.req_id)
     keys, nc, nb = _operands([req])
     final = jax.block_until_ready(
         _solo_fn(req.canon)(keys[0], nc[0], nb[0])
@@ -84,7 +88,8 @@ def _solo_metrics(req):
     return get_protocol(req.cfg.protocol).metrics(req.cfg, final)
 
 
-def run_batch(reqs, max_batch: int) -> list[tuple]:
+def run_batch(reqs, max_batch: int, force_solo: bool = False,
+              solo_reason: str | None = None) -> list[tuple]:
     """Dispatch one same-group batch; returns ``[(req, response)]`` in
     order, one entry per request, every response either 200 or a typed
     error body.
@@ -92,21 +97,49 @@ def run_batch(reqs, max_batch: int) -> list[tuple]:
     One request dispatches solo; two or more dispatch as one vmapped
     executable over the bucket-padded lane set.  Any batched failure
     degrades to per-request solo dispatch (the failure count lands in the
-    server's ``degraded_batches`` stat via the ``degraded`` flag)."""
+    server's ``degraded_batches`` stat via the ``degraded`` flag) and any
+    SOLO failure answers as the typed ``dispatch-failed`` error — the
+    signal the server's quarantine keys on.
+
+    ``force_solo=True`` skips the batched attempt entirely (the server's
+    circuit breaker, when a group's vmapped path is known-bad);
+    ``solo_reason`` labels the batch ``mode`` of such intentional solo
+    dispatches (``breaker-solo``, ``quarantined-solo``) so the access log
+    distinguishes policy from degradation."""
     t0 = time.monotonic()
     canon = reqs[0].canon
     group = obs.config_hash(canon)
     if len(reqs) == 1:
         req = reqs[0]
-        batch = {"size": 1, "padded": 1, "mode": "solo", "group": group}
+        batch = {"size": 1, "padded": 1, "mode": solo_reason or "solo",
+                 "group": group}
         try:
             m = _solo_metrics(req)
         except Exception as e:  # typed, never a crashed worker
-            err = schema.ServeError(f"solo dispatch failed: "
-                                    f"{type(e).__name__}: {e}")
+            err = schema.DispatchFailedError(f"solo dispatch failed: "
+                                             f"{type(e).__name__}: {e}")
             return [(req, err.to_response(req.req_id))]
         latency = time.monotonic() - (req.submitted or t0)
         return [(req, schema.ok_response(req, m, batch, latency))]
+
+    if force_solo:
+        # the breaker's solo-only mode: each request alone through the
+        # solo executable, by policy (not degradation — no degraded flag)
+        out = []
+        solo = {"size": len(reqs), "padded": 1,
+                "mode": solo_reason or "forced-solo", "group": group}
+        for req in reqs:
+            try:
+                m = _solo_metrics(req)
+            except Exception as e:
+                err = schema.DispatchFailedError(
+                    f"solo dispatch failed: {type(e).__name__}: {e}"
+                )
+                out.append((req, err.to_response(req.req_id)))
+                continue
+            latency = time.monotonic() - (req.submitted or t0)
+            out.append((req, schema.ok_response(req, m, solo, latency)))
+        return out
 
     padded = bucket_size(len(reqs), max_batch)
     lanes = list(reqs) + [reqs[-1]] * (padded - len(reqs))
@@ -137,7 +170,7 @@ def run_batch(reqs, max_batch: int) -> list[tuple]:
             try:
                 m = _solo_metrics(req)
             except Exception as e:
-                err = schema.ServeError(
+                err = schema.DispatchFailedError(
                     f"dispatch failed (batched, then solo): "
                     f"{type(e).__name__}: {e}"
                 )

@@ -133,6 +133,16 @@ class RequestTimeoutError(ServeError):
     kind = "timeout"
 
 
+class DispatchFailedError(ServeError):
+    """The request failed its SOLO dispatch (directly, or after a batched
+    dispatch degraded).  A stable kind rather than a message match because
+    the server's quarantine rule keys on it: an id that failed alone is
+    poison and must never ride a batch again (serve/server.py)."""
+
+    code = 500
+    kind = "dispatch-failed"
+
+
 class ShuttingDownError(ServeError):
     """The server is draining; no new requests."""
 
@@ -152,7 +162,10 @@ class ScenarioRequest:
     batch-group key AND the executable-registry key, so two requests with
     equal ``canon`` share one compiled program (the PR 4 contract the
     batching tests pin).  ``submitted`` is stamped by the server
-    (time.monotonic) when the request enters the queue."""
+    (time.monotonic) when the request enters the queue.  ``replayed``
+    marks a request re-admitted from the write-ahead log after a crash
+    (serve/wal.py): its responses carry ``"replayed": true`` so the
+    access log separates replay answers from live ones."""
 
     req_id: str
     cfg: SimConfig
@@ -160,6 +173,7 @@ class ScenarioRequest:
     seed: int
     timeout_s: float
     submitted: float = 0.0
+    replayed: bool = False
 
     def expired(self, now: float) -> bool:
         return self.timeout_s > 0 and (now - self.submitted) > self.timeout_s

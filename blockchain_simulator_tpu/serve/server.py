@@ -17,6 +17,31 @@ The in-process API the daemon (serve/__main__.py), the bench
   trade-off.  Dispatch is serve/dispatch.py: one vmapped executable per
   flush, answered from the warm registry/AOT cache.
 
+Robustness layers (the chaos drills in tools/chaos_drill.py exercise all
+of them; KNOWN_ISSUES.md #0h is the operator doc):
+
+- **Write-ahead log** (``wal_path=``, serve/wal.py): admission appends a
+  durable record before the queue sees the request; a restarted server
+  replays admitted-but-unanswered requests exactly once per pending id
+  (idempotent, access-logged with ``"replayed": true``) — a kill -9 loses
+  no admitted request.
+- **Supervised batcher**: a batcher-thread death is caught by the
+  supervisor loop and the thread restarts with exponential backoff
+  (``batcher_restarts`` on /stats); grouped-but-undispatched requests
+  survive the restart because the group state lives on the server, not
+  the thread.
+- **Per-group circuit breakers**: ``breaker_threshold`` consecutive
+  batched-dispatch failures flip a group to solo-only dispatch; after
+  ``breaker_cooldown_s`` one half-open probe batch decides re-close vs
+  re-open with doubled cooldown.  States surface on /stats.
+- **Quarantine**: a request whose SOLO dispatch failed (typed
+  ``dispatch-failed``) is poison — its id never joins a batch again
+  (singleton quarantined-solo flushes), across restarts via the WAL.
+- **Shutdown flush**: ``close()`` drains and answers every admitted
+  request; whatever the batcher cannot serve (dead thread, ``drain=False``
+  fast shutdown) is answered with a typed 503 + rejection manifest —
+  the no-silent-drop contract holds at exit too.
+
 Admission is gated on backend health (utils/health.py): a ``sick``/
 ``wedged`` verdict — seeded from the rolling HEALTH.jsonl at startup or
 pushed via :meth:`set_health` — pauses admission with typed 503s until a
@@ -33,10 +58,17 @@ import queue
 import threading
 import time
 
+from blockchain_simulator_tpu.chaos import inject
 from blockchain_simulator_tpu.serve import dispatch, schema
+from blockchain_simulator_tpu.serve.wal import WriteAheadLog
 from blockchain_simulator_tpu.utils import aotcache, obs
 
 _SHUTDOWN = object()
+
+# Batch-group key prefix for quarantined singleton flushes: unique per
+# request id, so poison can never share a group (or a vmapped dispatch)
+# with a healthy peer.
+_QUARANTINE_GROUP = "__quarantine__"
 
 
 class PendingResponse:
@@ -67,6 +99,66 @@ class PendingResponse:
         return self._response
 
 
+class CircuitBreaker:
+    """Per-batch-group breaker over the BATCHED dispatch path.
+
+    closed → (``threshold`` consecutive batched failures) → open: the
+    group dispatches solo-only (``breaker-solo``) so traffic keeps
+    flowing without re-paying a failing vmapped dispatch per flush.
+    open → (``cooldown_s`` elapsed) → half-open: ONE probe batch runs;
+    success closes, failure re-opens with the cooldown doubled (capped).
+    Only the batcher thread mutates state (the server lock guards the
+    stats() snapshot read)."""
+
+    __slots__ = ("threshold", "cooldown_s", "max_cooldown_s", "state",
+                 "failures", "opened_at", "cooldown", "opens")
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 30.0,
+                 max_cooldown_s: float = 300.0):
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.max_cooldown_s = float(max_cooldown_s)
+        self.state = "closed"
+        self.failures = 0          # consecutive batched failures
+        self.opened_at = 0.0
+        self.cooldown = self.cooldown_s
+        self.opens = 0
+
+    def allow_batched(self, now: float) -> bool:
+        """May this flush attempt a batched dispatch?  An elapsed cooldown
+        converts open → half-open and admits the probe."""
+        if self.state == "open":
+            if now - self.opened_at >= self.cooldown:
+                self.state = "half-open"
+                return True
+            return False
+        return True  # closed, or half-open probe already admitted
+
+    def record(self, failed: bool, now: float) -> None:
+        """Outcome of one batched dispatch attempt."""
+        if not failed:
+            self.failures = 0
+            self.state = "closed"
+            self.cooldown = self.cooldown_s
+            return
+        self.failures += 1
+        reopened = self.state == "half-open"
+        if reopened or self.failures >= self.threshold:
+            if reopened:
+                self.cooldown = min(self.cooldown * 2.0, self.max_cooldown_s)
+            self.state = "open"
+            self.opened_at = now
+            self.opens += 1
+
+    def snapshot(self) -> dict:
+        return {
+            "state": self.state,
+            "consecutive_failures": self.failures,
+            "opens": self.opens,
+            "cooldown_s": round(self.cooldown, 3),
+        }
+
+
 class ScenarioServer:
     """See the module docstring.  ``start=False`` builds the server without
     its batcher thread (the backpressure tests fill the queue that way);
@@ -81,6 +173,11 @@ class ScenarioServer:
         default_timeout_s: float = 30.0,
         health_log: str | None = None,
         start: bool = True,
+        wal_path: str | None = None,
+        wal_sync: bool = True,
+        breaker_threshold: int = 3,
+        breaker_cooldown_s: float = 30.0,
+        restart_backoff_s: float = 0.05,
     ):
         if max_batch < 1 or max_queue < 1:
             raise ValueError("max_batch and max_queue must be >= 1")
@@ -88,6 +185,9 @@ class ScenarioServer:
         self.max_wait_ms = float(max_wait_ms)
         self.max_queue = int(max_queue)
         self.default_timeout_s = float(default_timeout_s)
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.restart_backoff_s = float(restart_backoff_s)
 
         self._arrivals: queue.Queue = queue.Queue()
         self._lock = threading.Lock()
@@ -104,10 +204,27 @@ class ScenarioServer:
         self._stats = {
             "received": 0, "served": 0, "timeouts": 0, "batches": 0,
             "degraded_batches": 0, "rejected": {}, "errors": 0,
+            "replayed": 0, "quarantined": 0, "batcher_restarts": 0,
         }
         self._occupancy: dict[int, int] = {}
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._quarantine: set[str] = set()
+        # batch groups live on the SERVER, not the batcher thread's stack:
+        # a supervised restart resumes exactly the groups the dead thread
+        # left behind (the chaos batcher-kill drill pins this)
+        self._pending: dict = {}  # group key -> list[(req, PendingResponse)]
+        self._backoff = self.restart_backoff_s
         self._closing = False
+        self._drain = True
         self._thread: threading.Thread | None = None
+
+        self._wal: WriteAheadLog | None = None
+        self._wal_replayed_at_start = 0
+        if wal_path:
+            self._wal = WriteAheadLog(wal_path, sync=wal_sync)
+            self._quarantine |= self._wal.quarantined_ids()
+            self._wal.compact()
+            self._replay_wal()
         if start:
             self.start()
 
@@ -115,21 +232,45 @@ class ScenarioServer:
     def start(self) -> None:
         if self._thread is None or not self._thread.is_alive():
             self._thread = threading.Thread(
-                target=self._batcher, name="scenario-batcher", daemon=True
+                target=self._supervise, name="scenario-batcher", daemon=True
             )
             self._thread.start()
 
-    def close(self) -> None:
-        """Stop admitting, drain the queue (every admitted request gets its
-        answer), stop the batcher."""
+    def close(self, drain: bool = True) -> None:
+        """Stop admitting and stop the batcher.  ``drain=True`` (default):
+        the batcher dispatches every already-admitted request before
+        exiting.  ``drain=False``: queued requests are flushed as typed
+        503 rejections instead of dispatched (fast shutdown).  Either way
+        the close-side sweep below guarantees NO admitted request is left
+        unanswered or unlogged — even when the batcher thread is dead."""
         with self._lock:
-            if self._closing:
-                return
+            already = self._closing
             self._closing = True
-        if self._thread is not None and self._thread.is_alive():
+            self._drain = self._drain and drain
+        if not already and self._thread is not None \
+                and self._thread.is_alive():
             self._arrivals.put(_SHUTDOWN)
+        if self._thread is not None and self._thread.is_alive():
             self._thread.join()
         self._thread = None
+        # the sweep: whatever the batcher could not (or was told not to)
+        # serve gets its typed 503 + rejection manifest right here — the
+        # invariant checker's "no request unaccounted" has no exceptions
+        leftovers = []
+        while True:
+            try:
+                item = self._arrivals.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _SHUTDOWN:
+                leftovers.append(item)
+        with self._lock:
+            for group in self._pending.values():
+                leftovers.extend(group)
+            self._pending = {}
+        self._reject_shutdown(leftovers)
+        if self._wal is not None:
+            self._wal.close()
 
     def __enter__(self):
         return self
@@ -193,13 +334,23 @@ class ScenarioServer:
         except schema.ServeError as e:
             raise self._reject(e, req_id)
         pending = PendingResponse(req.req_id)
-        # depth check, flag re-check and enqueue are ONE atomic step: after
-        # close() flips _closing under this lock, nothing new can enter the
-        # arrivals queue, so the batcher's drain is complete
+        # depth check, flag re-check, WAL admit and enqueue are ONE atomic
+        # step: after close() flips _closing under this lock, nothing new
+        # can enter the arrivals queue, so the batcher's drain is complete
+        # — and the WAL admit is durable BEFORE the batcher can answer.
+        # The fsync under this lock serializes admission by design: moving
+        # it outside would open a close()-vs-enqueue stranding race, and
+        # the journal is opt-in (wal_sync=False / --wal-no-sync trades the
+        # durability fence away when admission throughput matters more)
         with self._lock:
             full = self._depth >= self.max_queue
             closing = self._closing
             if not full and not closing:
+                if self._wal is not None:
+                    try:
+                        self._wal.append_admit(req.req_id, obj)
+                    except OSError:
+                        pass  # a full disk must not take admission down
                 self._depth += 1
                 req.submitted = time.monotonic()
                 self._arrivals.put((req, pending))
@@ -226,13 +377,76 @@ class ScenarioServer:
             return e.to_response(req_id)
         return pending.result(wait_s)
 
+    # ------------------------------------------------------------ WAL layer
+    def _wal_done(self, req_id: str, code=None) -> None:
+        if self._wal is None:
+            return
+        try:
+            self._wal.append_done(req_id, code)
+        except OSError:
+            pass  # the journal must never block the answer
+
+    def _replay_wal(self) -> None:
+        """Re-admit every admitted-but-unanswered request from the WAL —
+        exactly once per pending id, bypassing the admission gates (they
+        were admitted once already; a paused health verdict must not
+        strand them a second time).  Requests that no longer parse are
+        answered with their typed rejection, access-logged with the
+        ``replayed`` mark, and retired from the journal."""
+        pend = self._wal.pending()
+        now = time.monotonic()
+        for rid, obj in pend:
+            with self._lock:
+                self._stats["replayed"] += 1
+            try:
+                req = schema.parse_request(
+                    dict(obj) if isinstance(obj, dict) else obj, rid,
+                    default_timeout_s=self.default_timeout_s,
+                )
+            except schema.ServeError as e:
+                resp = e.to_response(rid)
+                resp["replayed"] = True
+                with self._lock:
+                    by_kind = self._stats["rejected"]
+                    by_kind[e.kind] = by_kind.get(e.kind, 0) + 1
+                obs.record_run(resp, None)
+                self._wal_done(rid, e.code)
+                continue
+            req.replayed = True
+            req.submitted = now  # the original clock died with the crash
+            with self._lock:
+                self._depth += 1
+            self._arrivals.put((req, PendingResponse(rid)))
+        self._wal_replayed_at_start = len(pend)
+
     # -------------------------------------------------------------- batcher
+    def _supervise(self) -> None:
+        """The batcher's supervisor: a clean return (shutdown drain) ends
+        the thread; a crash restarts the loop after an exponential backoff
+        (``restart_backoff_s`` doubling, capped at 5 s, reset by the next
+        successful flush) instead of wedging every future client behind a
+        dead thread.  Group state lives on the server, so the restarted
+        loop resumes exactly where the dead one stopped."""
+        while True:
+            try:
+                self._batcher()
+                return
+            except Exception:
+                with self._lock:
+                    self._stats["batcher_restarts"] += 1
+                    closing = self._closing
+                    backoff = self._backoff
+                    self._backoff = min(backoff * 2.0, 5.0)
+                if closing:
+                    return  # close() sweeps the leftovers into typed 503s
+                time.sleep(backoff)
+
     def _batcher(self) -> None:
         """The micro-batching loop: accumulate per-group, flush a group at
         ``max_batch`` depth or ``max_wait_ms`` age, drain on shutdown."""
-        pending: dict = {}  # canon cfg -> list[(req, PendingResponse)]
-        closing = False
         while True:
+            closing = self._closing
+            pending = self._pending
             max_wait = self.max_wait_ms / 1000.0
             timeout = None if not pending else max_wait / 4 if max_wait > 0 \
                 else 0.001
@@ -249,38 +463,87 @@ class ScenarioServer:
                     closing = True
                 else:
                     req, fut = item
-                    pending.setdefault(req.canon, []).append((req, fut))
+                    key = (_QUARANTINE_GROUP, req.req_id) \
+                        if req.req_id in self._quarantine else req.canon
+                    pending.setdefault(key, []).append((req, fut))
                 try:
                     item = self._arrivals.get_nowait()
                 except queue.Empty:
                     item = None
+            closing = closing or self._closing
+
+            # the batcher-death injection point: a ChaosKill here escapes
+            # to the supervisor with the drained groups safely in
+            # self._pending (tools/chaos_drill.py batcher-kill scenario)
+            inject.chaos_point("serve.batcher", pending=len(pending))
 
             now = time.monotonic()
-            for canon in list(pending):
-                group = pending[canon]
+            for key in list(pending):
+                group = pending[key]
+                quarantined = isinstance(key, tuple) \
+                    and key[0] == _QUARANTINE_GROUP
                 due = (
                     closing
+                    or quarantined  # poison flushes alone, immediately
                     or len(group) >= self.max_batch
                     or (now - group[0][0].submitted) * 1000.0
                     >= self.max_wait_ms
                 )
                 if due:
-                    del pending[canon]
+                    del pending[key]
+                    if closing and not self._drain:
+                        # fast shutdown: typed 503s, never a vanished line
+                        self._reject_shutdown(group)
+                        continue
                     # the drain above can grow a group past max_batch in
                     # one iteration — dispatch in max_batch chunks.  The
-                    # guard is the daemon's last line: dispatch failures
-                    # are already typed inside run_batch, so anything
-                    # reaching here is a server bug — fail THIS group's
-                    # futures and keep serving rather than wedge every
-                    # future client behind a dead batcher thread.
+                    # guard is the daemon's second-to-last line: dispatch
+                    # failures are already typed inside run_batch, so
+                    # anything reaching here is a server bug — fail THIS
+                    # group's futures and keep serving (the supervisor
+                    # above is the last line, for the loop itself dying).
                     for i in range(0, len(group), self.max_batch):
                         chunk = group[i:i + self.max_batch]
                         try:
-                            self._flush(chunk)
+                            self._flush(chunk, quarantined=quarantined)
                         except Exception as e:
                             self._fail_group(chunk, e)
             if closing and not pending and self._arrivals.empty():
                 return
+
+    def _answer(self, req, fut, resp: dict, counter: str) -> None:
+        """The ONE terminal door: count, mark replay provenance, journal,
+        access-log, resolve the future.  Every path that answers an
+        admitted request routes through here so the accounting invariant
+        (received + replayed == answered) is structural, not situational."""
+        if req.replayed:
+            resp = dict(resp)
+            resp["replayed"] = True
+        with self._lock:
+            self._depth -= 1
+            if counter in ("served", "errors", "timeouts"):
+                self._stats[counter] += 1
+            else:
+                by_kind = self._stats["rejected"]
+                by_kind[counter] = by_kind.get(counter, 0) + 1
+        try:
+            obs.record_run(resp, req.cfg)
+        except Exception:
+            pass  # the access log must never block the answer
+        self._wal_done(req.req_id, resp.get("code"))
+        fut._set(resp)
+
+    def _reject_shutdown(self, group) -> None:
+        """Flush still-unanswered requests as typed 503s with rejection
+        manifests — the shutdown path of the no-silent-drop contract."""
+        err = schema.ShuttingDownError(
+            "server shut down before this request was dispatched"
+        )
+        for req, fut in group:
+            if fut.done():
+                continue
+            self._answer(req, fut, err.to_response(req.req_id),
+                         schema.ShuttingDownError.kind)
 
     def _fail_group(self, group, exc: Exception) -> None:
         """Answer every still-unanswered future of a group with a typed 500
@@ -291,18 +554,21 @@ class ScenarioServer:
         for req, fut in group:
             if fut.done():
                 continue
-            with self._lock:
-                self._depth -= 1
-                self._stats["errors"] += 1
-            try:
-                obs.record_run(err.to_response(req.req_id), req.cfg)
-            except Exception:
-                pass  # the access log must never block the answer
-            fut._set(err.to_response(req.req_id))
+            self._answer(req, fut, err.to_response(req.req_id), "errors")
 
-    def _flush(self, group) -> None:
-        """Dispatch one due group: expire stale requests, run the rest as
-        one batch (serve/dispatch.py), answer futures, access-log each."""
+    def _breaker(self, group_key: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(group_key)
+            if br is None:
+                br = self._breakers[group_key] = CircuitBreaker(
+                    self.breaker_threshold, self.breaker_cooldown_s
+                )
+            return br
+
+    def _flush(self, group, quarantined: bool = False) -> None:
+        """Dispatch one due group: expire stale requests, consult the
+        group's circuit breaker, run the rest as one batch
+        (serve/dispatch.py), answer futures, access-log each."""
         now = time.monotonic()
         live = []
         for req, fut in group:
@@ -310,41 +576,72 @@ class ScenarioServer:
                 err = schema.RequestTimeoutError(
                     f"timed out after {req.timeout_s:.3f}s in queue"
                 )
-                with self._lock:
-                    self._stats["timeouts"] += 1
-                    self._depth -= 1
-                obs.record_run(err.to_response(req.req_id), req.cfg)
-                fut._set(err.to_response(req.req_id))
+                self._answer(req, fut, err.to_response(req.req_id),
+                             "timeouts")
             else:
                 live.append((req, fut))
         if not live:
             return
-        results = dispatch.run_batch([r for r, _ in live], self.max_batch)
+        reqs = [r for r, _ in live]
+        group_key = obs.config_hash(reqs[0].canon)
+        force_solo = False
+        solo_reason = None
+        breaker = None
+        if quarantined:
+            # force_solo matters even here: a quarantined id resubmitted
+            # twice in one drain window groups with ITSELF, and a 2-deep
+            # quarantine flush must still never take the batched path
+            force_solo = True
+            solo_reason = "quarantined-solo"
+        elif len(reqs) >= 2:
+            breaker = self._breaker(group_key)
+            with self._lock:
+                allow = breaker.allow_batched(now)
+            if not allow:
+                force_solo = True
+                solo_reason = "breaker-solo"
+        results = dispatch.run_batch(
+            reqs, self.max_batch,
+            force_solo=force_solo, solo_reason=solo_reason,
+        )
         degraded = any(
             resp.get("batch", {}).get("degraded") for _, resp in results
         )
+        if breaker is not None and not force_solo:
+            with self._lock:
+                breaker.record(degraded, time.monotonic())
         with self._lock:
             self._stats["batches"] += 1
             if degraded:
                 self._stats["degraded_batches"] += 1
             b = len(live)
             self._occupancy[b] = self._occupancy.get(b, 0) + 1
+            self._backoff = self.restart_backoff_s  # the loop is healthy
         # run_batch answers in submission order, one response per request
         for (req, fut), (_, resp) in zip(live, results):
-            with self._lock:
-                self._depth -= 1
-                if resp.get("status") == "ok":
-                    self._stats["served"] += 1
-                else:
-                    self._stats["errors"] += 1
-            obs.record_run(resp, req.cfg)
-            fut._set(resp)
+            if resp.get("kind") == schema.DispatchFailedError.kind:
+                # failed SOLO: poison.  Never into a batch again — future
+                # submissions of this id flush as singleton groups, and
+                # the WAL mark keeps the rule across restarts.
+                with self._lock:
+                    fresh = req.req_id not in self._quarantine
+                    if fresh:
+                        self._quarantine.add(req.req_id)
+                        self._stats["quarantined"] += 1
+                if fresh and self._wal is not None:
+                    try:
+                        self._wal.append_quarantine(req.req_id)
+                    except OSError:
+                        pass
+            counter = "served" if resp.get("status") == "ok" else "errors"
+            self._answer(req, fut, resp, counter)
 
     # ---------------------------------------------------------------- stats
     def stats(self) -> dict:
         """The /stats endpoint body: serving counters, batch-occupancy
-        histogram, admission state, knobs, and the executable-registry
-        snapshot (utils/aotcache.stats_snapshot — the satellite contract)."""
+        histogram, admission state, circuit-breaker states, WAL/replay
+        provenance, knobs, and the executable-registry snapshot
+        (utils/aotcache.stats_snapshot — the satellite contract)."""
         with self._lock:
             rec = {
                 **{k: (dict(v) if isinstance(v, dict) else v)
@@ -355,13 +652,24 @@ class ScenarioServer:
                 "paused": self.paused,
                 "health": dict(self._health),
                 "closing": self._closing,
+                "quarantine_size": len(self._quarantine),
+                "breakers": {k: br.snapshot()
+                             for k, br in sorted(self._breakers.items())},
                 "knobs": {
                     "max_batch": self.max_batch,
                     "max_wait_ms": self.max_wait_ms,
                     "max_queue": self.max_queue,
                     "default_timeout_s": self.default_timeout_s,
+                    "breaker_threshold": self.breaker_threshold,
+                    "breaker_cooldown_s": self.breaker_cooldown_s,
                 },
             }
+            if self._wal is not None:
+                rec["wal"] = {
+                    "path": self._wal.path,
+                    "sync": self._wal.sync,
+                    "replayed_at_start": self._wal_replayed_at_start,
+                }
         rec["cache"] = aotcache.registry.stats_snapshot()
         return rec
 

@@ -37,7 +37,11 @@ Design constraints:
   initializes jax.
 - **Corrupt or stale disk entries must never take down a run**: every
   persistent-layer failure falls back to a fresh compile and is counted in
-  the stats instead of raised.
+  the stats instead of raised.  Entries carry a content checksum verified
+  BEFORE deserialization; a failed check self-heals (detect -> delete ->
+  recompile -> rewrite) and counts ``corrupt_healed`` in the stats and in
+  every manifest ``cache`` block — the chaos cache-corrupt drill
+  (tools/chaos_drill.py) flips real bits to prove it.
 """
 
 from __future__ import annotations
@@ -57,8 +61,19 @@ PERSIST_ENV = "BLOCKSIM_COMPILE_CACHE"
 XLA_CACHE_ENV = "BLOCKSIM_XLA_CACHE"
 
 # Bump when the on-disk entry layout changes: stale-format entries are
-# treated as misses, never parsed.
-_DISK_FORMAT = 1
+# treated as misses, never parsed.  v2 added the content checksum: the
+# serialized body is hashed at write time and verified BEFORE deserialize,
+# so a bit-flipped entry (KNOWN_ISSUES.md #0e's corruption folklore) is
+# detected, deleted, recompiled and rewritten — counted as
+# ``corrupt_healed`` — instead of feeding garbage to the deserializer or
+# silently degrading to a compile with no trace of why.
+_DISK_FORMAT = 2
+
+
+class _CorruptEntry(Exception):
+    """A persistent-cache entry that failed the content checksum (or could
+    not even be parsed): bit rot, a torn write, or outside interference —
+    the self-heal path's trigger, never surfaced to callers."""
 
 
 def _dist_version(name: str) -> str | None:
@@ -125,6 +140,7 @@ class ExecutableRegistry:
         self.disk_misses = 0
         self.disk_saves = 0
         self.disk_errors = 0
+        self.corrupt_healed = 0
         self.last_key: str | None = None
 
     # ---------------------------------------------------------- memoize ---
@@ -180,6 +196,7 @@ class ExecutableRegistry:
                 "disk_misses": self.disk_misses,
                 "disk_saves": self.disk_saves,
                 "disk_errors": self.disk_errors,
+                "corrupt_healed": self.corrupt_healed,
                 "last_key": self.last_key,
                 "persistent_dir": persistent_dir(),
             }
@@ -206,6 +223,7 @@ class ExecutableRegistry:
                 "hits": self.hits,
                 "misses": self.misses,
                 "key": self.last_key,
+                "corrupt_healed": self.corrupt_healed,
                 "persistent_dir": persistent_dir(),
             }
 
@@ -314,6 +332,36 @@ def _model_modules(cfg) -> None:
         from blockchain_simulator_tpu.models import raft_hb  # noqa: F401
 
 
+def _load_entry(path: str):
+    """Parse + checksum-verify one on-disk entry; returns ``(payload,
+    in_tree, out_tree)`` ready for ``deserialize_and_load``.  Raises
+    :class:`_CorruptEntry` on bit rot (unparseable container, checksum
+    mismatch, or a body that fails to parse despite its checksum) and
+    ``ValueError`` on a clean-but-stale format version — the two are
+    counted differently (``corrupt_healed`` vs ``disk_errors``) because
+    only the first means the bytes changed under us."""
+    try:
+        with open(path, "rb") as f:
+            rec = pickle.load(f)
+        fmt = rec[0]
+    except Exception as e:
+        raise _CorruptEntry(f"unparseable entry: {e}") from e
+    if fmt != _DISK_FORMAT:
+        raise ValueError(f"stale cache format {fmt}")
+    try:
+        _, digest, blob = rec
+    except Exception as e:
+        raise _CorruptEntry(f"malformed v{_DISK_FORMAT} entry: {e}") from e
+    if hashlib.sha256(blob).hexdigest() != digest:
+        raise _CorruptEntry("content checksum mismatch")
+    try:
+        return pickle.loads(blob)
+    except Exception as e:
+        # the checksum matched, so the WRITER produced a bad body — still
+        # a heal (delete + recompile + rewrite), never a crash
+        raise _CorruptEntry(f"checksummed body failed to parse: {e}") from e
+
+
 def aot_compile(name: str, jitted, example_args: tuple, cfg=None, extra=None):
     """AOT-stage ``jitted`` for ``example_args``: returns ``(compiled,
     info)`` where ``info`` = ``{"source": "disk"|"compile",
@@ -349,18 +397,27 @@ def aot_compile(name: str, jitted, example_args: tuple, cfg=None, extra=None):
 
             if cfg is not None:
                 _model_modules(cfg)
-            with open(path, "rb") as f:
-                fmt, payload, in_tree, out_tree = pickle.load(f)
-            if fmt != _DISK_FORMAT:
-                raise ValueError(f"stale cache format {fmt}")
+            payload, in_tree, out_tree = _load_entry(path)
             compiled = deserialize_and_load(payload, in_tree, out_tree)
             registry.disk_hits += 1
             info["source"] = "disk"
             info["compile_s"] = time.perf_counter() - t0
             info["cost"] = _cost(compiled)
             return compiled, info
+        except _CorruptEntry:
+            # the self-heal cycle: detect -> delete -> recompile (below)
+            # -> rewrite (the save path overwrites).  Counted so a flaky
+            # disk is visible in every manifest instead of masquerading
+            # as an unexplained slow compile.
+            registry.corrupt_healed += 1
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
         except Exception:
-            # corrupt/stale/foreign entry: recompile (and overwrite below)
+            # stale-format/foreign/undeserializable entry: recompile (and
+            # overwrite below) — the bytes were intact, the entry was not
+            # usable here
             registry.disk_errors += 1
     elif path:
         registry.disk_misses += 1
@@ -372,9 +429,11 @@ def aot_compile(name: str, jitted, example_args: tuple, cfg=None, extra=None):
             from jax.experimental.serialize_executable import serialize
 
             payload, in_tree, out_tree = serialize(compiled)
+            blob = pickle.dumps((payload, in_tree, out_tree))
+            digest = hashlib.sha256(blob).hexdigest()
             tmp = f"{path}.tmp.{os.getpid()}"
             with open(tmp, "wb") as f:
-                pickle.dump((_DISK_FORMAT, payload, in_tree, out_tree), f)
+                pickle.dump((_DISK_FORMAT, digest, blob), f)
             os.replace(tmp, path)  # atomic: readers never see a torn entry
             registry.disk_saves += 1
         except Exception:

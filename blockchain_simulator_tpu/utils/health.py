@@ -12,8 +12,11 @@ tunnel sick.  This module lifts that logic into a reusable, recorded form:
   structured verdict dict (``healthy`` or ``sick``); never raises.
 - :func:`probe_backend_supervised` — the parent-side classifier: runs the
   probe in a detached child and, when no verdict lands within ``patience_s``,
-  returns ``wedged`` while ABANDONING the child without killing it (killing a
-  client hung in backend init is what wedges the tunnel, KNOWN_ISSUES.md #3).
+  retries with jittered exponential backoff (one slow probe must not flip
+  the serve admission gate) before returning ``wedged`` — ABANDONING each
+  silent child without killing it (killing a client hung in backend init is
+  what wedges the tunnel, KNOWN_ISSUES.md #3).  The verdict records the
+  attempt count.
 - ``python -m blockchain_simulator_tpu.utils.health`` — prints exactly one
   JSON verdict line and appends it to a rolling ``HEALTH.jsonl``, so tunnel
   state across rounds becomes data (`--log ''` disables the file).
@@ -28,6 +31,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
 import subprocess
 import sys
 import tempfile
@@ -82,9 +86,41 @@ def probe_backend(platform: str | None = None) -> dict:
     return rec
 
 
-def probe_backend_supervised(patience_s: float = 120.0, env=None) -> dict:
+def probe_backend_supervised(
+    patience_s: float = 120.0,
+    env=None,
+    attempts: int = 2,
+    backoff_s: float = 2.0,
+    rng=None,
+) -> dict:
     """Run the probe in a detached child; classify a silent child as
-    ``wedged``.
+    ``wedged`` — but only after ``attempts`` probes, separated by a
+    jittered exponential backoff.
+
+    One slow probe (a cold tunnel paying its ~45 s init+compile under
+    load, a paging blip) must not flip the serving admission gate to
+    paused: a would-be ``wedged`` verdict is retried ``attempts - 1``
+    times, sleeping ``backoff_s * 2**k * uniform(0.5, 1.5)`` between
+    probes, and only the final miss is declared.  ``healthy``/``sick``
+    verdicts return immediately.  The returned record carries
+    ``attempts`` (probes actually run) so HEALTH.jsonl shows how hard the
+    verdict was earned.  ``rng`` (a ``random.random``-like callable)
+    makes the jitter injectable for deterministic drills.
+    """
+    rng = rng if rng is not None else random.random
+    rec: dict = {}
+    for attempt in range(1, max(1, int(attempts)) + 1):
+        rec = _probe_attempt(patience_s, env)
+        rec["attempts"] = attempt
+        if rec["verdict"] != "wedged" or attempt >= attempts:
+            break
+        time.sleep(backoff_s * (2.0 ** (attempt - 1)) * (0.5 + rng()))
+    rec["supervised"] = True
+    return rec
+
+
+def _probe_attempt(patience_s: float, env=None) -> dict:
+    """ONE supervised probe attempt.
 
     The child is ``python -m blockchain_simulator_tpu.utils.health --child``
     (one JSON line on stdout).  If no line lands within ``patience_s`` the
@@ -158,7 +194,6 @@ def probe_backend_supervised(patience_s: float = 120.0, env=None) -> dict:
         except OSError:
             pass
     # an abandoned child keeps its output file: it is still writing to it
-    rec["supervised"] = True
     return rec
 
 
@@ -168,21 +203,15 @@ def latest_verdict(path: str | None = None) -> dict | None:
     verdict line exists.  Read-only and never raises: the scenario server
     (serve/) consults this at startup to decide whether admission opens
     paused — a stale or missing log must default to serving, not crash."""
+    from blockchain_simulator_tpu.utils import obs
+
     path = path or os.environ.get(HEALTH_ENV)
     if not path:
         return None
     last = None
-    try:
-        with open(path) as f:
-            for line in f:
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if isinstance(rec, dict) and rec.get("verdict") in VERDICTS:
-                    last = rec
-    except OSError:
-        return None
+    for rec in obs.read_jsonl(path):
+        if rec.get("verdict") in VERDICTS:
+            last = rec
     return last
 
 
@@ -213,6 +242,11 @@ def main(argv=None) -> int:
     p.add_argument("--patience", type=float, default=120.0,
                    help="supervised mode: seconds to wait for the child's "
                         "verdict before declaring the tunnel wedged")
+    p.add_argument("--attempts", type=int, default=2,
+                   help="supervised mode: probes (jittered exponential "
+                        "backoff between them) before a silent tunnel is "
+                        "declared wedged — one slow probe must not flip "
+                        "the serve admission gate")
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. cpu) for the probe")
     p.add_argument("--log", default="HEALTH.jsonl",
@@ -228,7 +262,8 @@ def main(argv=None) -> int:
         rec = probe_backend(platform=args.platform)
     else:
         env = {"JAX_PLATFORMS": args.platform} if args.platform else None
-        rec = probe_backend_supervised(patience_s=args.patience, env=env)
+        rec = probe_backend_supervised(patience_s=args.patience, env=env,
+                                       attempts=args.attempts)
     rec["ts"] = round(time.time(), 3)
     print(json.dumps(rec), flush=True)
     append_health(rec, args.log or None)

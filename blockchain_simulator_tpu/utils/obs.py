@@ -154,6 +154,31 @@ def timed_run(sim, key, measure_key=None):
     return final, compile_s, run_s
 
 
+def read_jsonl(path: str) -> list[dict]:
+    """Every parseable dict record of a JSONL file, in order — the one
+    tolerant reader the rolling logs share (runs.jsonl access-log checks
+    in chaos/invariants.py, health verdicts).  Torn lines (a crash or a
+    concurrent append mid-write) and non-dict records are skipped; a
+    missing file reads as empty — log readers never raise."""
+    out: list[dict] = []
+    try:
+        f = open(path)
+    except OSError:
+        return out
+    with f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
 def append_jsonl(record: dict, path: str | None = None) -> None:
     """Append one JSON line; path defaults to $BLOCKSIM_RUNS_JSONL (no-op
     when neither is set).  Append failures are swallowed: observability must

@@ -186,6 +186,25 @@ def test_bench_compare_never_gates_graph_cost_trajectories(tmp_path):
     assert "graph_sim_pbft_tick_gflops" in proc.stdout
 
 
+def test_bench_compare_never_gates_chaos_counters(tmp_path):
+    """The chaos drill's counters (chaos_ prefix, tools/chaos_drill.py)
+    are lower-is-better with their own exit-code gate: a DROP (faults
+    fixed) must chart without tripping the throughput rule, and a rise is
+    the drill's failure to report, not bench_compare's."""
+    runs = tmp_path / "runs.jsonl"
+    rows = []
+    for metric in ("chaos_invariant_violations", "chaos_replay_divergence"):
+        rows += [
+            {"metric": metric, "value": 3, "manifest": {"obs_schema": 1}},
+            {"metric": metric, "value": 0, "manifest": {"obs_schema": 1}},
+        ]
+    runs.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    proc = _run([str(BENCH_COMPARE), _bench_artifact(tmp_path, 1, 100.0),
+                 "--runs", str(runs)])
+    assert proc.returncode == 0, proc.stdout
+    assert "chaos_invariant_violations" in proc.stdout
+
+
 def test_bench_compare_gates_p99_latency_inverted(tmp_path):
     """serve_p99_ms is lower-is-better AND gated: an increase beyond the
     threshold is the regression; a decrease (faster serving) never trips."""
@@ -246,18 +265,22 @@ def test_lint_sh_chains_both_gates(tmp_path):
         # its gate is covered end-to-end by tests/test_zzgraph.py.
         # SERVE=0: the serving smoke compiles a daemon's worth of
         # executables — covered by tests/test_zserve.py's self-test.
+        # CHAOS=0: the chaos drill runs every scenario twice — covered by
+        # tests/test_zchaos.py (scenario-level + slow CLI test).
         env={**os.environ, "BLOCKSIM_RUNS_JSONL": str(runs),
-             "WARM_BENCH": "0", "GRAPH": "0", "SERVE": "0"},
+             "WARM_BENCH": "0", "GRAPH": "0", "SERVE": "0", "CHAOS": "0"},
     )
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
     assert "jaxlint" in proc.stdout and "no regression" in proc.stdout
-    # the jaxgraph and serve stages are chained (and skippable) — pin the
-    # script contract
+    # the jaxgraph, serve and chaos stages are chained (and skippable) —
+    # pin the script contract
     script = (REPO / "tools" / "lint.sh").read_text()
     assert "blockchain_simulator_tpu.lint.graph" in script
     assert '"${GRAPH:-1}"' in script
     assert "blockchain_simulator_tpu.serve --self-test" in script
     assert '"${SERVE:-1}"' in script
+    assert "tools/chaos_drill.py --quick" in script
+    assert '"${CHAOS:-1}"' in script
     recs = [json.loads(ln) for ln in runs.read_text().strip().splitlines()]
     lint_recs = [r for r in recs if r.get("metric") == "jaxlint_new_findings"]
     assert lint_recs and lint_recs[-1]["value"] == 0

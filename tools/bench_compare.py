@@ -126,9 +126,12 @@ def trajectory(rows: list[dict]) -> dict[str, list[dict]]:
 # growth is already gated against GRAPH_BASELINE.json by the lint.graph
 # budget gate — chart, never gate.  Keyed on the "graph_" PREFIX, not the
 # unit suffixes: a future bench metric like "peak_rss_bytes", where a drop
-# IS meaningful, must stay under the throughput rule.
+# IS meaningful, must stay under the throughput rule.  The chaos drill's
+# counters ("chaos_invariant_violations"/"chaos_replay_divergence",
+# tools/chaos_drill.py) are the same shape: zero is the goal, any rise
+# already fails the drill's own exit code — chart, never gate.
 UNGATED_SUFFIXES = ("_findings", "_compile_s", "_p50_ms")
-UNGATED_PREFIXES = ("graph_",)
+UNGATED_PREFIXES = ("graph_", "chaos_")
 
 # Serving latency is lower-is-better AND gated: the serve smoke/bench land
 # a p99 trajectory (serve_p99_ms) whose REGRESSION is an increase, so the

@@ -12,6 +12,9 @@
 #     tracing on the 2-core box);
 #   - the serving smoke (`python -m blockchain_simulator_tpu.serve
 #     --self-test`) drives the daemon over real HTTP (SERVE=0 skips);
+#   - the chaos drill (`tools/chaos_drill.py --quick`) runs every scripted
+#     fault scenario twice under one seed, invariant-clean and
+#     deterministic (CHAOS=0 skips);
 #   - `tools/bench_compare.py` sees no metric drop beyond its threshold.
 #
 # When $BLOCKSIM_RUNS_JSONL is set the lint runs themselves land in
@@ -62,6 +65,24 @@ if [ "${SERVE:-1}" != "0" ]; then
     serve_rc=$?
     if [ "$serve_rc" -ne 0 ]; then
         echo "lint.sh: serve smoke FAILED (rc=$serve_rc)" >&2
+        rc=1
+    fi
+fi
+
+# Chaos drill (tools/chaos_drill.py --quick): every scripted fault
+# scenario run twice under one chaos seed — zero invariant violations,
+# byte-equal summaries — against the real server/dispatch/cache stack;
+# lands chaos_invariant_violations / chaos_replay_divergence in
+# runs.jsonl (charted, never gated by bench_compare — the drill's own
+# exit code is the gate).  CHAOS=0 skips (~40 s of drills on the 2-core
+# box); the full kill -9 leg lives in the slow-marked test and the
+# committed ARTIFACT_chaos_drill.json.
+if [ "${CHAOS:-1}" != "0" ]; then
+    echo "== chaos drill =="
+    python tools/chaos_drill.py --quick
+    chaos_rc=$?
+    if [ "$chaos_rc" -ne 0 ]; then
+        echo "lint.sh: chaos drill FAILED (rc=$chaos_rc)" >&2
         rc=1
     fi
 fi

@@ -1,8 +1,9 @@
 """CLI: ``python -m blockchain_simulator_tpu.lint.graph``.
 
 Flags mirror jaxlint's where the concept is shared (``--format``,
-``--baseline``, ``--no-baseline``, ``--write-baseline``, ``--list-rules``)
-plus graph-only ones (``--list-programs``, ``--only``, ``--tolerance``).
+``--baseline``, ``--no-baseline``, ``--write-baseline``,
+``--prune-baseline``, ``--list-rules``) plus graph-only ones
+(``--list-programs``, ``--only``, ``--tolerance``).
 Exit codes: 0 = clean vs baseline, 1 = new findings, 2 = a program failed
 to trace / bad baseline / usage error.
 
@@ -65,6 +66,11 @@ def main(argv=None) -> int:
     p.add_argument("--write-baseline", action="store_true",
                    help="write current findings + measured budgets as the "
                         "new baseline (preserves justifications) and exit 0")
+    p.add_argument("--prune-baseline", action="store_true",
+                   help="baseline hygiene: drop finding entries the audit "
+                        "no longer produces and budgets for programs no "
+                        "longer in the catalog (retired factories); never "
+                        "re-pins live budgets or touches justifications")
     p.add_argument("--list-rules", action="store_true")
     p.add_argument("--list-programs", action="store_true")
     p.add_argument("--only", nargs="*", default=None, metavar="PROGRAM",
@@ -100,6 +106,19 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
         specs = [s for s in specs if s.program in args.only]
+
+    if args.prune_baseline:
+        # guard BEFORE the (minutes-long) audit: a subset run cannot
+        # distinguish retired from out-of-scope, and pruning needs a file
+        if subset:
+            print("jaxgraph: --prune-baseline needs a full catalog run "
+                  "(drop --only)", file=sys.stderr)
+            return 2
+        prune_path = args.baseline or audit_mod.default_baseline_path()
+        if args.no_baseline or not os.path.exists(prune_path):
+            print(f"jaxgraph: --prune-baseline needs an existing baseline "
+                  f"({prune_path})", file=sys.stderr)
+            return 2
 
     _force_platform()
 
@@ -147,6 +166,24 @@ def main(argv=None) -> int:
                                        full=not subset)
         print(f"jaxgraph: wrote {len(doc['budgets'])} budget(s) and "
               f"{len(doc['entries'])} finding entr(ies) to "
+              f"{baseline_path}")
+        return 0
+
+    if args.prune_baseline:
+        if result.errors:
+            for e in result.errors:
+                print(f"jaxgraph: {e}", file=sys.stderr)
+            return 2
+        info = audit_mod.prune_baseline(baseline_path, result, baseline)
+        for r, pr, d in info["dropped_entries"]:
+            print(f"jaxgraph: pruned fixed entry {r} @ {pr}: {d!r}")
+        for r, pr, d in info["shrunk_entries"]:
+            print(f"jaxgraph: shrank overcounted entry {r} @ {pr}: {d!r}")
+        for pr in info["dropped_budgets"]:
+            print(f"jaxgraph: dropped retired budget {pr}")
+        print(f"jaxgraph: pruned {len(info['dropped_entries'])} entr(ies), "
+              f"shrank {len(info['shrunk_entries'])}, dropped "
+              f"{len(info['dropped_budgets'])} retired budget(s) in "
               f"{baseline_path}")
         return 0
 

@@ -429,5 +429,65 @@ def write_baseline(
     return doc
 
 
+def prune_baseline(path: str, result: AuditResult, old: dict) -> dict:
+    """Baseline hygiene (``--prune-baseline``, the jaxlint analog): rewrite
+    the baseline keeping only what the current catalog still justifies —
+    finding entries shrink to the count actually consumed by ``result``'s
+    findings (fixed entries drop entirely) and budgets whose program is no
+    longer in the catalog drop (retired programs must not linger as stale
+    pins).  Live budget VALUES and all justifications are preserved
+    untouched: pruning never re-pins — that is ``--write-baseline``'s job.
+
+    Returns ``{"dropped_entries": [...], "shrunk_entries": [...],
+    "dropped_budgets": [...]}``.  ``result`` must come from a FULL audit
+    run (a subset run cannot distinguish retired from out-of-scope)."""
+    consumed: Counter = Counter()
+    for f in result.findings:
+        if f.rule in ("budget-missing", "budget-regression"):
+            continue
+        consumed[f.key()] += f.count
+
+    audited = set(result.reports)
+    dropped_budgets = sorted(set(old["budgets"]) - audited)
+    budgets = {name: pin for name, pin in sorted(old["budgets"].items())
+               if name in audited}
+
+    dropped_entries, shrunk_entries, entries = [], [], []
+    for key, entry in sorted(old["entries"].items()):
+        rule, program, detail = key
+        live = min(entry["count"], consumed.get(key, 0))
+        if live == 0:
+            dropped_entries.append(key)
+            continue
+        if live < entry["count"]:
+            shrunk_entries.append(key)
+        entries.append({
+            "rule": rule, "program": program, "detail": detail,
+            "count": live, "justification": entry.get("justification", ""),
+        })
+
+    doc = {
+        "jaxgraph_baseline": 1,
+        "comment": (
+            "IR-level grandfathered findings + per-program analytical "
+            "FLOP/byte budgets (Lowered.cost_analysis, bit-stable).  "
+            "Regenerate with `python -m blockchain_simulator_tpu.lint.graph "
+            "--write-baseline` (justifications preserved); new programs "
+            "must come in clean and budgeted."
+        ),
+        "tolerance": old.get("tolerance", DEFAULT_TOLERANCE),
+        "budgets": budgets,
+        "entries": entries,
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=False)
+        f.write("\n")
+    return {
+        "dropped_entries": dropped_entries,
+        "shrunk_entries": shrunk_entries,
+        "dropped_budgets": dropped_budgets,
+    }
+
+
 def default_baseline_path() -> str:
     return os.path.join(REPO_ROOT, BASELINE_NAME)

@@ -264,6 +264,40 @@ def build_catalog() -> list[ProgramSpec]:
     specs.append(dynf_spec("sweep_dynf.raft_c2", "raft_tick",
                            {"n_crashed": 2}, "dynf:raft_tick", False))
 
+    # --- parallel/sweep.mesh_dyn_batched_fn ("partition-dyn-sweep") -----
+    # The mesh-partitioned sweep executable (parallel/partition.py layer):
+    # shard_map over the batch axis, per-device lax.map of the unvmapped
+    # dyn sim.  Divergence twins mirror the dynf pair — fault configs
+    # differing only in counts must trace to ONE fingerprint per mesh, or
+    # a mesh sweep silently recompiles per point.  The nodes arm traces
+    # the explicit-sharding pjit path (node axis sharded for large n).
+    def partition_dynf_spec(name, fc_kw, sweep_n, node_n, group, budget):
+        def build():
+            import dataclasses as _dc
+
+            from blockchain_simulator_tpu.parallel import sweep
+            from blockchain_simulator_tpu.parallel.mesh import make_mesh
+
+            cfg = cfgs["pbft_tick"]
+            cfg = cfg.with_(faults=_dc.replace(cfg.faults, **fc_kw))
+            mesh = make_mesh(n_node_shards=node_n, n_sweep=sweep_n)
+            fn = _raw(sweep.mesh_dyn_batched_fn)(cfg, mesh)
+            b = max(sweep_n, 2)
+            return fn, (_keys_sds(b), _i32_sds((b,)), _i32_sds((b,)))
+
+        return ProgramSpec(name, "partition-dyn-sweep", build,
+                           divergence_group=group, budget=budget)
+
+    specs.append(partition_dynf_spec(
+        "partition_dynf.pbft", {"n_byzantine": 1}, 2, 1,
+        "partition-dynf:pbft_tick", True))
+    specs.append(partition_dynf_spec(
+        "partition_dynf.pbft_b2", {"n_byzantine": 2}, 2, 1,
+        "partition-dynf:pbft_tick", False))
+    specs.append(partition_dynf_spec(
+        "partition_dynf.pbft_nodes", {"n_byzantine": 1}, 1, 2,
+        None, True))
+
     # --- serve/dispatch._solo_fn ("serve-solo") -------------------------
     # The scenario server's un-vmapped degrade/solo path.  Divergence
     # twins mirror the dynf pair: requests differing only in fault counts

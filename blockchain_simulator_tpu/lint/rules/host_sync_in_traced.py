@@ -56,7 +56,7 @@ def _is_tracing_callee(callee: ast.AST, aliases: dict[str, str]) -> bool:
     r = common.resolve(callee, aliases)
     if r in TRACING_CALLS:
         return True
-    # local shard_map compat wrappers (parallel/shard.py::_shard_map) keep
+    # local shard_map compat wrappers (parallel/partition.py::_shard_map) keep
     # their callable-arg position; match by trailing name
     d = common.dotted(callee)
     return bool(d) and d.split(".")[-1].lstrip("_") == "shard_map"

@@ -1,0 +1,279 @@
+"""Partition rules: the single place meshes meet executables.
+
+Every mesh-partitioned program in the repo — the node-sharded sim wrappers
+(parallel/shard.py), the mesh-partitioned fault sweeps (parallel/sweep.py)
+and the scenario server's mesh-sharded batched dispatch (serve/dispatch.py)
+— goes through one of two doors here:
+
+- :func:`match_partition_rules` turns a declaration of ``(regex path
+  pattern, PartitionSpec)`` rules into a full PartitionSpec pytree for any
+  state/buffer tree (the SNIPPETS.md [2] pattern): first ``re.search``
+  match on the ``/``-joined key path wins, scalar leaves are never
+  partitioned, specs are rank-padded so ``shard_map`` sees full-rank specs.
+- :func:`partition` compiles a function against a mesh (the SNIPPETS.md
+  [3] pattern): explicit global-view shardings prefer **pjit** (``jax.jit``
+  with ``NamedSharding``s — XLA GSPMD partitions the internals), per-shard
+  specs fall back to a **shard_map-wrapped jit** (map-style named-axis
+  collectives, the sim wrappers' delivery ops), and a **mesh of size 1
+  degenerates** to a plain ``jax.jit`` under the mesh context so the
+  compiled program is bit-identical to the unpartitioned one.
+
+Why the sweep path partitions the BATCH axis with shard_map rather than
+vmap sharding: a vmapped sim lowers its dynamic-update-slice pushes to
+scatter (lint/graph found them; XLA:CPU serializes scatter —
+KNOWN_ISSUES.md #0b), so the per-device body here is a ``lax.map`` of the
+UNVMAPPED program — plain DUS, no batch lockstep.  Measured on the
+8-virtual-device CPU mesh (tools/mesh_sweep_bench.py): ~2.3x per lane over
+the single-device vmapped sweep program at 10k nodes, rows bit-equal under
+the exact sampler.  On a real TPU mesh the devices additionally run in
+parallel; on the 1-core CPU box the win is purely the scatter-free body.
+
+Registry contract: partitioned executables live in the unified registry
+(utils/aotcache.py) keyed on ``(factory, cfg, mesh)`` — the mesh IS part of
+the key, so a mesh-sharded entry never collides with the single-device one
+and the one-executable-per-fault-structure sweep contract survives per
+mesh (tests/test_zzpartition.py pins it).
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+
+import numpy as np
+
+REPLICATED = None  # sentinel alias: a rule spec of None means "replicate"
+
+
+def _spec_cls():
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec
+
+
+def mesh_size(mesh) -> int:
+    """Total device count of a mesh."""
+    return int(np.asarray(mesh.devices).size)
+
+
+def path_name(path) -> str:
+    """``/``-joined name of a pytree key path (dict keys, dataclass/struct
+    field names, sequence indices)."""
+    parts = []
+    for p in path:
+        if hasattr(p, "name"):
+            parts.append(str(p.name))
+        elif hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def match_partition_rules(rules, tree):
+    """PartitionSpec pytree for ``tree`` from ``((regex, spec), ...)`` rules.
+
+    The first rule whose pattern ``re.search``-matches the leaf's
+    ``/``-joined path wins; its spec (a ``PartitionSpec`` or
+    :data:`REPLICATED`) is padded with ``None`` to the leaf's rank, so
+    ``P(NODES_AXIS)`` declares "shard dim 0, replicate the rest" for any
+    rank.  Scalar (0-d or size-1) leaves are never partitioned.  A
+    non-scalar leaf matching no rule raises — silent replication is how
+    sharding bugs hide (SNIPPETS.md [2] raises the same way).
+    """
+    import jax
+
+    P = _spec_cls()
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(path, leaf):
+        shape = getattr(leaf, "shape", ())
+        ndim = len(shape)
+        if ndim == 0 or int(np.prod(shape)) == 1:
+            return P()
+        name = path_name(path)
+        for pat, spec in compiled:
+            if pat.search(name) is None:
+                continue
+            entries = tuple(spec) if spec is not None else ()
+            if len(entries) > ndim:
+                raise ValueError(
+                    f"partition rule {pat.pattern!r} spec {spec} has "
+                    f"{len(entries)} entries for rank-{ndim} leaf {name!r}"
+                )
+            return P(*entries, *([None] * (ndim - len(entries))))
+        raise ValueError(f"no partition rule matched leaf {name!r} "
+                         f"(shape {tuple(shape)})")
+
+    return jax.tree_util.tree_map_with_path(spec_for, tree)
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    """``shard_map`` across jax versions: ``jax.shard_map`` + ``check_vma``
+    on current releases, ``jax.experimental.shard_map`` + ``check_rep`` on
+    0.4.x.  Replication checking is waived either way: delivery ops mix
+    gathered (unreplicated) and replicated values; correctness is covered
+    by the sharded-vs-unsharded equivalence tests."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as sm
+
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_rep=False)
+
+
+def _named_shardings(mesh, specs):
+    """PartitionSpec pytree -> NamedSharding pytree over ``mesh`` (specs
+    are the pytree leaves: a bare spec broadcasts as a jit prefix)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    P = _spec_cls()
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda s: isinstance(s, P),
+    )
+
+
+def partition(fn, mesh, *, in_shardings=None, out_shardings=None,
+              in_specs=None, out_specs=None, wrap_jit=True):
+    """Compile ``fn`` against ``mesh`` — the one mesh↔executable door.
+
+    Exactly one style may be given (the SNIPPETS.md [3] selection rule):
+
+    - ``in_shardings``/``out_shardings`` (PartitionSpec pytrees, *global*
+      view): explicit shardings are honoured via pjit — ``jax.jit`` with
+      ``NamedSharding``s; XLA GSPMD partitions the function body.  Both
+      must be given, or neither makes sense to honour.  A mesh of size 1
+      degenerates to plain ``jax.jit(fn)`` run under the mesh context —
+      bit-identical to the unpartitioned program (pinned in
+      tests/test_zzpartition.py).
+    - ``in_specs``/``out_specs`` (PartitionSpec pytrees, *per-shard*
+      view): the shard_map-wrapped-jit fallback — map-style collectives
+      over the mesh axis names (``psum``/``all_gather`` in
+      ops/delivery.py).  Size-1 meshes keep the shard_map wrapper: the
+      body's axis names must stay bound (a 1-device ``psum`` is identity).
+      ``wrap_jit=False`` returns the bare shard_map-wrapped function for
+      callers that embed it in a larger jitted program (the shard.py sim
+      wrappers init state under their own jit) — the traced IR is then
+      identical to a hand-rolled shard_map call.
+    """
+    explicit = in_shardings is not None or out_shardings is not None
+    mapped = in_specs is not None or out_specs is not None
+    if explicit and mapped:
+        raise ValueError(
+            "partition() takes either explicit shardings (pjit) or "
+            "per-shard specs (shard_map), not both"
+        )
+    if explicit:
+        if in_shardings is None or out_shardings is None:
+            raise ValueError(
+                "partition() requires both in_shardings and out_shardings "
+                "when using explicit-sharding pjit; pass in_specs/out_specs "
+                "for the shard_map fallback instead"
+            )
+        if not wrap_jit:
+            raise ValueError(
+                "wrap_jit=False is a shard_map-path option: jit IS the "
+                "pjit mechanism for explicit shardings"
+            )
+        import jax
+
+        # per-call jit is this layer's JOB: every caller is itself a
+        # @cached_factory factory (shard.py wrappers, sweep.mesh_dyn_
+        # batched_fn), so the registry memoizes the wrapper one level up —
+        # the same sanctioning the rule grants those factories directly
+        if mesh_size(mesh) == 1:
+            @functools.wraps(fn)
+            def single_device_fn(*args):
+                with mesh:
+                    return fn(*args)
+
+            return jax.jit(single_device_fn)  # jaxlint: disable=static-arg-recompile-hazard
+        return jax.jit(  # jaxlint: disable=static-arg-recompile-hazard
+            fn,
+            in_shardings=_named_shardings(mesh, in_shardings),
+            out_shardings=_named_shardings(mesh, out_shardings),
+        )
+    if not mapped:
+        raise ValueError(
+            "partition() needs in_shardings/out_shardings (pjit) or "
+            "in_specs/out_specs (shard_map)"
+        )
+    shmapped = _shard_map(fn, mesh, in_specs, out_specs)
+    if not wrap_jit:
+        return shmapped
+    import jax
+
+    # cached one level up, same as the explicit-sharding arm above
+    return jax.jit(shmapped)  # jaxlint: disable=static-arg-recompile-hazard
+
+
+# ----------------------------------------------------- mesh-sweep helpers ---
+
+
+def sweep_axis_size(mesh) -> int:
+    """Size of the mesh's sweep axis (0 when the mesh has none)."""
+    from blockchain_simulator_tpu.parallel.mesh import SWEEP_AXIS
+
+    return int(dict(mesh.shape).get(SWEEP_AXIS, 0))
+
+
+def pad_points(points, lanes: int):
+    """Pad ``points`` (any list) to a multiple of ``lanes`` by repeating the
+    last element — the uneven-grid lanes of a mesh dispatch (a padded lane
+    costs one discarded per-device map step, same trade as serve's bucket
+    padding).  Returns ``(padded, n_real)``."""
+    points = list(points)
+    if not points:
+        raise ValueError("pad_points needs at least one point")
+    n_real = len(points)
+    rem = n_real % lanes
+    if rem:
+        points = points + [points[-1]] * (lanes - rem)
+    return points, n_real
+
+
+def mesh_shape_dict(mesh) -> dict:
+    """``{axis name: size}`` of a mesh as plain JSON-able types — the one
+    serialization every mesh-reporting surface shares (serve batch blocks,
+    /stats, the daemon READY line)."""
+    return {str(k): int(v) for k, v in dict(mesh.shape).items()}
+
+
+def batched_out_shardings(cfg, mesh, out_avals):
+    """Global-view out specs for a vmapped ``[B, ...]`` final-state pytree:
+    batch dim over the sweep axis; when the mesh also has >1 node shards,
+    a leaf whose dim 1 is ``cfg.n``-sized rides the nodes axis there (the
+    "node axis optionally sharded for large n" option — GSPMD propagates
+    the constraint into the scan).  Only dim 1 is considered: node state
+    is ``[N, ...]`` by repo convention (shard.py), and shape-matching
+    deeper dims could tag a same-sized non-node dim (e.g. a slot table at
+    ``pbft_max_slots == n``) — such leaves just stay replicated."""
+    import jax
+
+    from blockchain_simulator_tpu.parallel.mesh import NODES_AXIS, SWEEP_AXIS
+
+    P = _spec_cls()
+    n_nodes = int(dict(mesh.shape).get(NODES_AXIS, 1))
+    sweep = SWEEP_AXIS if sweep_axis_size(mesh) > 1 else None
+
+    def leaf_spec(leaf):
+        shape = tuple(getattr(leaf, "shape", ()))
+        entries = [sweep]
+        for i, d in enumerate(shape[1:]):
+            if (i == 0 and n_nodes > 1 and d == cfg.n
+                    and cfg.n % n_nodes == 0):
+                entries.append(NODES_AXIS)
+            else:
+                entries.append(None)
+        return P(*entries)
+
+    return jax.tree.map(leaf_spec, out_avals)

@@ -1,11 +1,19 @@
-"""Node-shard SPMD execution via ``shard_map``.
+"""Node-shard SPMD execution: thin spec declarations over partition rules.
 
 The entire simulation — state init, the full ``lax.scan`` over ticks, every
-delivery collective — runs as one SPMD program over the mesh's ``nodes`` axis:
-node state ``[N, ...]`` and ring buffers ``[D, N, ...]`` are row-sharded, and
-the delivery ops in ``ops/delivery.py`` globalize sender-side quantities with
-``all_gather``/``psum``/``pmax`` over ICI (SURVEY.md §2: the TPU-native
-equivalent of the reference's simulated point-to-point channels).
+delivery collective — runs as one SPMD program over the mesh's ``nodes``
+axis: node state ``[N, ...]`` and ring buffers ``[D, N, ...]`` are
+row-sharded, and the delivery ops in ``ops/delivery.py`` globalize
+sender-side quantities with ``all_gather``/``psum``/``pmax`` over ICI
+(SURVEY.md §2: the TPU-native equivalent of the reference's simulated
+point-to-point channels).
+
+Since the partition layer landed, each wrapper here is just its *rule
+declaration* (regex path patterns → PartitionSpecs, ``parallel/
+partition.py``) plus the engine call: specs come from
+``partition.match_partition_rules`` and the mesh meets the executable
+through ``partition.partition`` — there is no direct ``shard_map`` call
+site in this module (tests/test_zzpartition.py pins that).
 
 All four factories here are traced over a 2-device mesh and budget-pinned
 by the graph audit (lint/graph/programs.py ``shard.*`` specs).
@@ -13,75 +21,77 @@ by the graph audit (lint/graph/programs.py ``shard.*`` specs).
 
 from __future__ import annotations
 
+import re
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from blockchain_simulator_tpu.models.base import get_protocol
+from blockchain_simulator_tpu.parallel import partition
 from blockchain_simulator_tpu.parallel.mesh import NODES_AXIS
 from blockchain_simulator_tpu.utils import aotcache
 from blockchain_simulator_tpu.utils import prng
 from blockchain_simulator_tpu.utils.config import SimConfig
 
+# ----------------------------------------------------- rule declarations ---
 
-def _shard_map(f, mesh, in_specs, out_specs):
-    """``shard_map`` across jax versions: ``jax.shard_map`` + ``check_vma``
-    on current releases, ``jax.experimental.shard_map`` + ``check_rep`` on
-    0.4.x.  Replication checking is waived either way: delivery ops mix
-    gathered (unreplicated) and replicated values; correctness is covered by
-    the sharded-vs-unsharded equivalence tests."""
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map(
-            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_vma=False,
-        )
-    from jax.experimental.shard_map import shard_map as sm
-
-    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-              check_rep=False)
+# Node state [N, ...]: row-shard dim 0 — except the protocol's
+# ``GLOBAL_FIELDS`` (per-slot accumulators): replicated, each shard carries
+# a partial that the protocol's ``finalize`` combines.
+def state_rules(global_fields=()):
+    rules = []
+    if global_fields:
+        names = "|".join(re.escape(f) for f in global_fields)
+        rules.append((rf"(^|/)({names})$", partition.REPLICATED))
+    rules.append((r".*", P(NODES_AXIS)))
+    return tuple(rules)
 
 
-def mixed_specs(state, bufs):
-    """PartitionSpecs for the mixed shard-sim (models/mixed.py): raft leaves
-    ``[S, ...]`` row-shard over the shard axis; the S-representative PBFT
-    layer is replicated (every device steps an identical copy — see
-    mixed.step)."""
-    shard0 = lambda x: P(NODES_AXIS, *([None] * (x.ndim - 1)))
-    repl = lambda x: P(*([None] * x.ndim))
-    return (
-        type(state)(
-            raft=jax.tree.map(shard0, state.raft),
-            pbft=jax.tree.map(repl, state.pbft),
-        ),
-        type(bufs)(
-            raft=jax.tree.map(shard0, bufs.raft),
-            pbft=jax.tree.map(repl, bufs.pbft),
-        ),
-    )
+# Ring/delivery buffers [D, N, ...]: the node axis is dim 1.
+BUF_RULES = ((r".*", P(None, NODES_AXIS)),)
+
+# Mixed shard-sim (models/mixed.py): raft leaves [S, ...] row-shard over
+# the shard axis; the S-representative PBFT layer is replicated (every
+# device steps an identical copy — see mixed.step).
+MIXED_RULES = (
+    (r"^raft(/|$)", P(NODES_AXIS)),
+    (r"^pbft(/|$)", partition.REPLICATED),
+)
 
 
 def state_specs(state, global_fields=()):
-    """PartitionSpecs for a state pytree: leaves are [N, ...] (shard dim 0)
-    except the protocol's ``GLOBAL_FIELDS`` (per-slot accumulators,
-    replicated spec — each shard carries a partial that the protocol's
-    ``finalize`` combines)."""
-
-    def state_leaf_spec(path, x):
-        name = path[-1].name if hasattr(path[-1], "name") else None
-        if name in global_fields:
-            return P(*([None] * x.ndim))
-        return P(NODES_AXIS, *([None] * (x.ndim - 1)))
-
-    return jax.tree_util.tree_map_with_path(state_leaf_spec, state)
+    """PartitionSpecs for a state pytree (rule-matched; see state_rules)."""
+    return partition.match_partition_rules(state_rules(global_fields), state)
 
 
 def node_specs(state, bufs, global_fields=()):
-    """PartitionSpecs: state leaves per ``state_specs``; buffer leaves are
-    [D, N, ...] (shard dim 1)."""
-    bufs_spec = jax.tree.map(
-        lambda x: P(None, NODES_AXIS, *([None] * (x.ndim - 2))), bufs
+    """(state specs, buffer specs) for a (state, bufs) pair."""
+    return (
+        state_specs(state, global_fields),
+        partition.match_partition_rules(BUF_RULES, bufs),
     )
-    return state_specs(state, global_fields), bufs_spec
+
+
+def mixed_specs(state, bufs):
+    """PartitionSpecs for the mixed shard-sim's (state, bufs) pair."""
+    return (
+        partition.match_partition_rules(MIXED_RULES, state),
+        partition.match_partition_rules(MIXED_RULES, bufs),
+    )
+
+
+def _partitioned(run, mesh, in_specs, out_specs):
+    """The wrappers' one door to the mesh: per-shard specs → shard_map
+    (partition.py's fallback path), unjitted — each wrapper embeds the
+    result in its own ``@jax.jit`` sim exactly as before the layer
+    existed, so the traced IR (and its pinned budget) is unchanged."""
+    return partition.partition(
+        run, mesh, in_specs=in_specs, out_specs=out_specs, wrap_jit=False
+    )
+
+
+# ------------------------------------------------------------- factories ---
 
 
 @aotcache.cached_factory("shard-round")
@@ -105,8 +115,8 @@ def _make_sharded_round_fn(cfg: SimConfig, mesh: Mesh):
         state = pbft_round.scan_rounds(cfg_local, state, key)
         return pbft_round.finalize(state, NODES_AXIS)
 
-    shmapped = _shard_map(
-        run, mesh=mesh, in_specs=(P(), state_spec), out_specs=state_spec
+    shmapped = _partitioned(
+        run, mesh, in_specs=(P(), state_spec), out_specs=state_spec
     )
 
     @jax.jit
@@ -140,8 +150,8 @@ def _make_sharded_raft_hb_fn(cfg: SimConfig, mesh: Mesh):
     def run(key, state, bufs):
         return raft_hb.scan_from_init(cfg_local, state, bufs, key)
 
-    shmapped = _shard_map(
-        run, mesh=mesh, in_specs=(P(), state_spec, bufs_spec), out_specs=state_spec
+    shmapped = _partitioned(
+        run, mesh, in_specs=(P(), state_spec, bufs_spec), out_specs=state_spec
     )
 
     @jax.jit
@@ -173,8 +183,8 @@ def _make_sharded_mixed_fast_fn(cfg: SimConfig, mesh: Mesh):
     def run(key, state, bufs):
         return mixed.scan_fast(cfg_local, state, bufs, key)
 
-    shmapped = _shard_map(
-        run, mesh=mesh, in_specs=(P(), state_spec, bufs_spec), out_specs=state_spec
+    shmapped = _partitioned(
+        run, mesh, in_specs=(P(), state_spec, bufs_spec), out_specs=state_spec
     )
 
     @jax.jit
@@ -235,9 +245,8 @@ def make_sharded_sim_fn(cfg: SimConfig, mesh: Mesh):
             state = proto.finalize(state, NODES_AXIS)
         return state
 
-    shmapped = _shard_map(
-        run, mesh=mesh, in_specs=(P(), state_spec, bufs_spec),
-        out_specs=state_spec,
+    shmapped = _partitioned(
+        run, mesh, in_specs=(P(), state_spec, bufs_spec), out_specs=state_spec
     )
 
     @jax.jit

@@ -42,7 +42,8 @@ import jax.numpy as jnp
 
 from blockchain_simulator_tpu.chaos import inject
 from blockchain_simulator_tpu.models.base import canonical_fault_cfg, get_protocol
-from blockchain_simulator_tpu.parallel.mesh import SWEEP_AXIS
+from blockchain_simulator_tpu.parallel import partition
+from blockchain_simulator_tpu.parallel.mesh import NODES_AXIS, SWEEP_AXIS
 from blockchain_simulator_tpu.runner import (
     UnbatchableConfigError,
     check_batchable,
@@ -84,6 +85,69 @@ def dyn_batched_fn(cfg: SimConfig):
 _dyn_batched_fn = dyn_batched_fn
 
 
+@aotcache.cached_factory("partition-dyn-sweep")
+def mesh_dyn_batched_fn(cfg: SimConfig, mesh):
+    """Mesh-partitioned ``batched(keys[B], n_crashed[B], n_byzantine[B]) ->
+    finals``: the (fault level, seed) batch axis sharded over the mesh's
+    ``sweep`` axis, through the partition layer (parallel/partition.py).
+
+    Three arms, all one registry entry per (fault structure, mesh) — the
+    mesh rides the key, so the one-executable-per-fault-structure contract
+    holds per mesh:
+
+    - **mesh of size 1**: degenerates to :func:`dyn_batched_fn` — the
+      PR 4 single-device program itself, so results are trivially
+      bit-identical to the plain vmapped sweep (the registry serves the
+      ``sweep-batched-dynf`` entry; sweeps and serving stay warm).
+    - **sweep-only mesh** (nodes axis 1): shard_map over the batch axis
+      with a per-device body of ``lax.map`` over the UNVMAPPED dyn sim.
+      The unvmapped body keeps its dynamic-update-slice pushes as plain
+      DUS instead of vmap's scatter lowering (KNOWN_ISSUES #0b: XLA:CPU
+      serializes scatter) — measured ~2.3x per lane over the vmapped
+      program at 10k nodes on the CPU mesh, before any device parallelism.
+    - **nodes axis > 1**: the explicit-sharding pjit arm — batch over
+      ``sweep``, each lane's node dim over ``nodes``
+      (partition.batched_out_shardings), XLA GSPMD partitioning the scan:
+      the "node axis optionally sharded for large n" option.
+
+    Callers must pad the batch to a multiple of the sweep axis size
+    (partition.pad_points; run_dyn_points does).  Bit-equality to the
+    single-device path is pinned under the exact sampler in
+    tests/test_zzpartition.py — the normal CLT sampler keeps the module
+    caveat's ±1-tick float latitude."""
+    fn = make_dyn_sim_fn(cfg)
+    if partition.mesh_size(mesh) == 1:
+        return dyn_batched_fn(cfg)
+    if int(dict(mesh.shape).get(NODES_AXIS, 1)) > 1:
+        batched = jax.vmap(fn)
+        b = max(partition.sweep_axis_size(mesh), 1)
+        keys_sds = jax.eval_shape(
+            lambda: jax.vmap(jax.random.key)(jnp.arange(b, dtype=jnp.uint32))
+        )
+        cnt_sds = jax.ShapeDtypeStruct((b,), jnp.int32)
+        outs = jax.eval_shape(batched, keys_sds, cnt_sds, cnt_sds)
+        from jax.sharding import PartitionSpec as P
+
+        lane = P(SWEEP_AXIS) if partition.sweep_axis_size(mesh) > 1 else P()
+        return partition.partition(
+            batched, mesh,
+            in_shardings=(lane, lane, lane),
+            out_shardings=partition.batched_out_shardings(cfg, mesh, outs),
+        )
+
+    def body(keys, nc, nb):
+        # per-device: local lanes run SEQUENTIALLY through the unvmapped
+        # program (lax.map = scan of the solo body, constant program size)
+        return jax.lax.map(lambda args: fn(*args), (keys, nc, nb))
+
+    from jax.sharding import PartitionSpec as P
+
+    lane = P(SWEEP_AXIS)
+    return partition.partition(
+        body, mesh, in_specs=(lane, lane, lane), out_specs=lane
+    )
+
+
 def run_seed_sweep(cfg: SimConfig, seeds, mesh=None):
     """Run ``len(seeds)`` simulations of one config in a single vmapped
     program; returns a list of per-seed metrics dicts."""
@@ -121,7 +185,7 @@ def _dyn_operands(cfg: SimConfig, fc) -> tuple[int, int]:
 
 
 def run_dyn_points(canon: SimConfig, points, record: bool = True,
-                   n_out: int | None = None):
+                   n_out: int | None = None, mesh=None):
     """THE group-dispatch primitive: one vmapped executable over an
     arbitrary list of same-structure ``(cfg, seed)`` points.
 
@@ -139,19 +203,33 @@ def run_dyn_points(canon: SimConfig, points, record: bool = True,
     callers that write their own access-log records (the server does);
     ``n_out`` computes host-side metrics for only the first ``n_out``
     points (the server's bucket-padded lanes are duplicates whose metrics
-    would be discarded)."""
+    would be discarded).
+
+    With ``mesh`` set the batch axis shards over the mesh's sweep axis
+    through :func:`mesh_dyn_batched_fn` (parallel/partition.py): the point
+    list is padded to a multiple of the sweep axis size by repeating the
+    last point (padding lanes ride at the tail, so real-point indices are
+    unchanged and pad metrics are never computed).  A mesh of size 1 takes
+    the single-device path verbatim."""
     points = list(points)
     # the batched-dispatch chaos point: the drills inject raise/hang/slow
     # here — the exact exception path a real backend fault takes through
     # the sweeps AND the serving degrade machinery (chaos/inject.py)
     inject.chaos_point("sweep.dyn_dispatch", canon=canon, n=len(points))
+    dispatch_points = points
+    if mesh is not None and partition.mesh_size(mesh) > 1:
+        lanes = max(partition.sweep_axis_size(mesh), 1)
+        dispatch_points, _ = partition.pad_points(points, lanes)
+        batched = mesh_dyn_batched_fn(canon, mesh)
+    else:
+        batched = dyn_batched_fn(canon)
     keys = jax.vmap(jax.random.key)(
-        jnp.asarray([s for _, s in points], jnp.uint32)
+        jnp.asarray([s for _, s in dispatch_points], jnp.uint32)
     )
-    ops = [_dyn_operands(cfg, cfg.faults) for cfg, _ in points]
+    ops = [_dyn_operands(cfg, cfg.faults) for cfg, _ in dispatch_points]
     nc = jnp.asarray([o[0] for o in ops], jnp.int32)
     nb = jnp.asarray([o[1] for o in ops], jnp.int32)
-    finals = jax.block_until_ready(dyn_batched_fn(canon)(keys, nc, nb))
+    finals = jax.block_until_ready(batched(keys, nc, nb))
     out = []
     if n_out is not None:
         points = points[:n_out]
@@ -165,19 +243,19 @@ def run_dyn_points(canon: SimConfig, points, record: bool = True,
     return out
 
 
-def _run_dyn_group(cfg: SimConfig, canon: SimConfig, fcs, seeds):
+def _run_dyn_group(cfg: SimConfig, canon: SimConfig, fcs, seeds, mesh=None):
     """One compiled program for every (fault config, seed) point of a
     same-structure group; returns {fc: [metrics per seed]} with rows
     bit-equal to ``run_seed_sweep(cfg.with_(faults=fc), seeds)``."""
     points = [(cfg.with_(faults=fc), seed) for fc in fcs for seed in seeds]
-    rows = run_dyn_points(canon, points)
+    rows = run_dyn_points(canon, points, mesh=mesh)
     n_s = len(seeds)
     return {
         fc: rows[i * n_s:(i + 1) * n_s] for i, fc in enumerate(fcs)
     }
 
 
-def run_fault_sweep(cfg: SimConfig, fault_configs, seeds):
+def run_fault_sweep(cfg: SimConfig, fault_configs, seeds, mesh=None):
     """BASELINE config 4: sweep fault configs with seeds vmapped inside.
     Returns {fault_config: [metrics per seed]}.
 
@@ -190,7 +268,13 @@ def run_fault_sweep(cfg: SimConfig, fault_configs, seeds):
     Un-batchable configs (today: the mixed shard sim — the typed
     ``runner.UnbatchableConfigError``, classified here without
     string-matching) take the static ``run_seed_sweep`` path
-    (one static compile per fault config)."""
+    (one static compile per fault config).
+
+    ``mesh`` shards every dynamic-operand group's (fault config, seed)
+    batch over the mesh's sweep axis (see :func:`run_dyn_points`); the
+    static fallback stays single-device — its mesh story is
+    ``run_seed_sweep(mesh=...)``'s node-sharded one, with different
+    divisibility requirements."""
     fault_configs = list(fault_configs)
     groups: dict[SimConfig, list] = {}
     order = {}
@@ -206,7 +290,7 @@ def run_fault_sweep(cfg: SimConfig, fault_configs, seeds):
         order[fc] = canon
     done: dict = {}
     for canon, fcs in groups.items():
-        done.update(_run_dyn_group(cfg, canon, fcs, seeds))
+        done.update(_run_dyn_group(cfg, canon, fcs, seeds, mesh=mesh))
     results = {}
     for fc in fault_configs:
         if order[fc] is None:
@@ -216,11 +300,14 @@ def run_fault_sweep(cfg: SimConfig, fault_configs, seeds):
     return results
 
 
-def run_byzantine_sweep(cfg: SimConfig, f_values=None, seeds=(0,), forge=True):
+def run_byzantine_sweep(cfg: SimConfig, f_values=None, seeds=(0,), forge=True,
+                        mesh=None):
     """BASELINE config 4 end-to-end: sweep the Byzantine count f over
     ``f_values`` (default 0..(n-1)//3), seeds batched per f — the whole
     sweep is ONE vmapped executable over (f, seed) (dynamic fault operands;
-    the per-f recompile this loop used to pay is gone).
+    the per-f recompile this loop used to pay is gone).  ``mesh`` shards
+    the (f, seed) cross product over the mesh's sweep axis
+    (:func:`run_dyn_points`; tools/mesh_sweep_bench.py is the artifact).
 
     Each entry reports the two safety-relevant outcomes next to the fault
     level: ``forged_commits`` (a slot finalized although no honest leader ever
@@ -242,7 +329,7 @@ def run_byzantine_sweep(cfg: SimConfig, f_values=None, seeds=(0,), forge=True):
         for f in f_values
     ]
     # dedup: repeated f values share one fault config (and one batch row set)
-    res = run_fault_sweep(cfg, list(dict.fromkeys(fcs)), seeds)
+    res = run_fault_sweep(cfg, list(dict.fromkeys(fcs)), seeds, mesh=mesh)
     out = []
     for f, fc in zip(f_values, fcs):
         for seed, m in zip(seeds, res[fc]):

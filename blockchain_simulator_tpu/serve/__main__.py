@@ -317,6 +317,10 @@ def main(argv=None) -> int:
                    help="request template whose batch group is compiled "
                         "(or AOT-cache-loaded) across every bucket size "
                         "before serving starts")
+    p.add_argument("--mesh-sweep", type=int, default=0, metavar="N",
+                   help="shard batched dispatches over an N-device sweep "
+                        "mesh (parallel/partition.py; 0 = single-device). "
+                        "N must not exceed the backend's device count")
     p.add_argument("--platform", default="cpu",
                    help="jax platform to pin before backend init "
                         "(default cpu — a serving smoke must never hang "
@@ -329,6 +333,17 @@ def main(argv=None) -> int:
                    help="warm requests in the self-test latency sample")
     args = p.parse_args(argv)
 
+    if args.mesh_sweep and args.mesh_sweep > 1:
+        # the host-device-count flag is read at backend INIT (lint.graph
+        # contract): without it a CPU backend exposes ONE device and an
+        # N-device sweep mesh cannot exist.  Only effective before the
+        # first backend touch — which is after this line either way.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{args.mesh_sweep}"
+            ).strip()
     _force_platform(args.platform)
     if args.self_test:
         args.port = 0
@@ -338,6 +353,18 @@ def main(argv=None) -> int:
     from blockchain_simulator_tpu.utils import aotcache
 
     aotcache.enable_xla_cache()
+    mesh = None
+    if args.mesh_sweep and args.mesh_sweep > 1:
+        from blockchain_simulator_tpu.parallel.mesh import make_mesh
+
+        try:
+            mesh = make_mesh(n_node_shards=1, n_sweep=args.mesh_sweep)
+        except ValueError as e:
+            # e.g. XLA_FLAGS pre-pinned a smaller host device count: a
+            # clear one-line refusal, not a traceback before READY
+            print(f"serve: --mesh-sweep {args.mesh_sweep} impossible on "
+                  f"this backend: {e}", file=sys.stderr)
+            return 2
     server = ScenarioServer(
         max_batch=args.max_batch,
         max_wait_ms=args.max_wait_ms,
@@ -348,6 +375,7 @@ def main(argv=None) -> int:
         wal_sync=not args.wal_no_sync,
         breaker_threshold=args.breaker_threshold,
         breaker_cooldown_s=args.breaker_cooldown_s,
+        mesh=mesh,
     )
     if args.prewarm:
         try:
@@ -362,6 +390,7 @@ def main(argv=None) -> int:
         "max_batch": server.max_batch, "max_wait_ms": server.max_wait_ms,
         "max_queue": server.max_queue, "wal": args.wal,
         "replayed": server._wal_replayed_at_start if args.wal else 0,
+        "mesh": server.stats()["mesh"],
     }), flush=True)
     try:
         httpd.serve_forever()

@@ -89,10 +89,16 @@ def _solo_metrics(req):
 
 
 def run_batch(reqs, max_batch: int, force_solo: bool = False,
-              solo_reason: str | None = None) -> list[tuple]:
+              solo_reason: str | None = None, mesh=None) -> list[tuple]:
     """Dispatch one same-group batch; returns ``[(req, response)]`` in
     order, one entry per request, every response either 200 or a typed
     error body.
+
+    ``mesh`` routes the batched dispatch onto the mesh-partitioned sweep
+    executable (``sweep.run_dyn_points(mesh=...)`` →
+    ``mesh_dyn_batched_fn`` — the batch axis shards over the mesh's sweep
+    axis; ROADMAP item 1b).  Solo/degrade dispatches stay single-device
+    regardless: a one-request program has no batch axis to shard.
 
     One request dispatches solo; two or more dispatch as one vmapped
     executable over the bucket-padded lane set.  Any batched failure
@@ -145,6 +151,10 @@ def run_batch(reqs, max_batch: int, force_solo: bool = False,
     lanes = list(reqs) + [reqs[-1]] * (padded - len(reqs))
     batch = {"size": len(reqs), "padded": padded, "mode": "batched",
              "group": group}
+    if mesh is not None:
+        from blockchain_simulator_tpu.parallel import partition
+
+        batch["mesh"] = partition.mesh_shape_dict(mesh)
     try:
         from blockchain_simulator_tpu.parallel import sweep
 
@@ -153,7 +163,7 @@ def run_batch(reqs, max_batch: int, force_solo: bool = False,
         # request access-log records; n_out skips pad-lane metrics
         rows = sweep.run_dyn_points(
             canon, [(r.cfg, r.seed) for r in lanes], record=False,
-            n_out=len(reqs),
+            n_out=len(reqs), mesh=mesh,
         )
         out = []
         for req, m in zip(reqs, rows):

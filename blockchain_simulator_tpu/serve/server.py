@@ -59,6 +59,9 @@ import threading
 import time
 
 from blockchain_simulator_tpu.chaos import inject
+from blockchain_simulator_tpu.parallel.partition import (
+    mesh_shape_dict as _mesh_shape_dict,
+)
 from blockchain_simulator_tpu.serve import dispatch, schema
 from blockchain_simulator_tpu.serve.wal import WriteAheadLog
 from blockchain_simulator_tpu.utils import aotcache, obs
@@ -178,9 +181,14 @@ class ScenarioServer:
         breaker_threshold: int = 3,
         breaker_cooldown_s: float = 30.0,
         restart_backoff_s: float = 0.05,
+        mesh=None,
     ):
         if max_batch < 1 or max_queue < 1:
             raise ValueError("max_batch and max_queue must be >= 1")
+        # a jax.sharding.Mesh (or None): batched flushes dispatch onto the
+        # mesh-partitioned sweep executable (serve/dispatch.py mesh arg;
+        # parallel/partition.py) — the daemon's --mesh-sweep knob
+        self.mesh = mesh
         self.max_batch = int(max_batch)
         self.max_wait_ms = float(max_wait_ms)
         self.max_queue = int(max_queue)
@@ -602,7 +610,7 @@ class ScenarioServer:
                 solo_reason = "breaker-solo"
         results = dispatch.run_batch(
             reqs, self.max_batch,
-            force_solo=force_solo, solo_reason=solo_reason,
+            force_solo=force_solo, solo_reason=solo_reason, mesh=self.mesh,
         )
         degraded = any(
             resp.get("batch", {}).get("degraded") for _, resp in results
@@ -663,6 +671,11 @@ class ScenarioServer:
                     "breaker_threshold": self.breaker_threshold,
                     "breaker_cooldown_s": self.breaker_cooldown_s,
                 },
+                # the batched-dispatch mesh (None = single-device): axis
+                # name -> size, matching the registry snapshot's per-entry
+                # mesh descriptors below
+                "mesh": (_mesh_shape_dict(self.mesh)
+                         if self.mesh is not None else None),
             }
             if self._wal is not None:
                 rec["wal"] = {
@@ -704,7 +717,7 @@ class ScenarioServer:
                 r.submitted = time.monotonic()
                 reqs.append(r)
             t0 = time.monotonic()
-            results = dispatch.run_batch(reqs, self.max_batch)
+            results = dispatch.run_batch(reqs, self.max_batch, mesh=self.mesh)
             walls[str(size)] = round(time.monotonic() - t0, 3)
             for _, resp in results:
                 if resp.get("status") != "ok":

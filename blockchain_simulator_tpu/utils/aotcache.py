@@ -107,6 +107,23 @@ def _backend_if_initialized() -> str | None:
     return None
 
 
+def _mesh_desc(args: tuple, kwargs: tuple) -> str | None:
+    """Compact mesh descriptor (``"sweep=8,nodes=1"``) of the first
+    ``jax.sharding.Mesh`` among a registry key's arguments, or None for a
+    single-device entry.  Duck-typed (``axis_names`` + ``devices`` +
+    mapping ``shape``) — this module never imports jax (module
+    docstring: a stats read must not be able to init a backend)."""
+    for a in args + tuple(v for _, v in kwargs):
+        if hasattr(a, "axis_names") and hasattr(a, "devices"):
+            try:
+                return ",".join(
+                    f"{k}={int(v)}" for k, v in dict(a.shape).items()
+                )
+            except Exception:
+                return None
+    return None
+
+
 def _display_key(name: str, args: tuple, kwargs: tuple) -> str:
     """Short human-readable key for stats/manifests: the factory name plus
     the config hash of the first dataclass argument (the join key used
@@ -132,6 +149,10 @@ class ExecutableRegistry:
     def __init__(self, maxsize: int = 128):
         self.maxsize = maxsize
         self._entries: collections.OrderedDict = collections.OrderedDict()
+        # key -> mesh descriptor string (None for single-device entries);
+        # kept in lockstep with _entries so stats can expose the mesh spec
+        # of every live entry without re-parsing keys
+        self._mesh: dict = {}
         self._lock = threading.RLock()
         self.hits = 0
         self.misses = 0
@@ -142,6 +163,7 @@ class ExecutableRegistry:
         self.disk_errors = 0
         self.corrupt_healed = 0
         self.last_key: str | None = None
+        self.last_mesh: str | None = None
 
     # ---------------------------------------------------------- memoize ---
     def get(self, name: str, args: tuple, kwargs: dict, build):
@@ -153,6 +175,7 @@ class ExecutableRegistry:
                 self.hits += 1
                 self._entries.move_to_end(key)
                 self.last_key = _display_key(name, args, key[2])
+                self.last_mesh = self._mesh.get(key)
                 return self._entries[key]
         # build OUTSIDE the lock: builds trace/compile for minutes and must
         # not serialize unrelated factories behind a single mutex
@@ -160,10 +183,13 @@ class ExecutableRegistry:
         with self._lock:
             self.misses += 1
             self.last_key = _display_key(name, args, key[2])
+            self.last_mesh = _mesh_desc(args, key[2])
             self._entries[key] = value
             self._entries.move_to_end(key)
+            self._mesh[key] = self.last_mesh
             while len(self._entries) > self.maxsize:
-                self._entries.popitem(last=False)
+                evicted, _ = self._entries.popitem(last=False)
+                self._mesh.pop(evicted, None)
                 self.evictions += 1
         return value
 
@@ -176,9 +202,11 @@ class ExecutableRegistry:
         with self._lock:
             if name is None:
                 self._entries.clear()
+                self._mesh.clear()
                 return
             for key in [k for k in self._entries if k[0] == name]:
                 del self._entries[key]
+                self._mesh.pop(key, None)
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -206,13 +234,26 @@ class ExecutableRegistry:
         :meth:`stats` record plus a per-factory entry breakdown.  The
         scenario server (serve/) attaches this to its ``/stats`` endpoint
         and its bench/self-test manifests so a running daemon's cache state
-        is inspectable without touching jax (pure counter reads)."""
+        is inspectable without touching jax (pure counter reads).
+
+        Schema note (v. mesh bump): ``mesh`` maps each factory to a
+        ``{mesh descriptor: entry count}`` breakdown — the mesh spec of
+        every live registry entry (``"sweep=8,nodes=1"``; single-device
+        entries count under ``"none"``).  Readers must tolerate absent or
+        grown keys (the serve/ contract)."""
         with self._lock:
             by_factory: dict[str, int] = {}
+            by_mesh: dict[str, dict[str, int]] = {}
             for key in self._entries:
                 by_factory[key[0]] = by_factory.get(key[0], 0) + 1
+                desc = self._mesh.get(key) or "none"
+                fac = by_mesh.setdefault(key[0], {})
+                fac[desc] = fac.get(desc, 0) + 1
             snap = self.stats()  # RLock: safe to re-enter
             snap["by_factory"] = dict(sorted(by_factory.items()))
+            snap["mesh"] = {
+                k: dict(sorted(v.items())) for k, v in sorted(by_mesh.items())
+            }
             return snap
 
     def manifest(self) -> dict:
@@ -223,6 +264,7 @@ class ExecutableRegistry:
                 "hits": self.hits,
                 "misses": self.misses,
                 "key": self.last_key,
+                "mesh": self.last_mesh,
                 "corrupt_healed": self.corrupt_healed,
                 "persistent_dir": persistent_dir(),
             }

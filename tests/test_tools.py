@@ -227,6 +227,29 @@ def test_bench_compare_gates_p99_latency_inverted(tmp_path):
     assert proc.returncode == 0, proc.stdout
 
 
+def test_bench_compare_gates_sweep_points_per_s(tmp_path):
+    """The mesh-sweep smoke's throughput metric rides the default
+    higher-is-better gate: a drop beyond the threshold fails, a rise never
+    does (tools/mesh_sweep_bench.py --quick emits it)."""
+    runs = tmp_path / "runs.jsonl"
+
+    def write(vals):
+        runs.write_text("".join(
+            json.dumps({"metric": "sweep_points_per_s", "value": v,
+                        "manifest": {"obs_schema": 1}}) + "\n"
+            for v in vals))
+
+    write([10.0, 2.0])  # 5x slower: beyond the 50% threshold
+    proc = _run([str(BENCH_COMPARE), _bench_artifact(tmp_path, 1, 100.0),
+                 "--runs", str(runs)])
+    assert proc.returncode == 1
+    assert "REGRESSION: sweep_points_per_s" in proc.stdout
+    write([2.0, 10.0])  # faster sweeps never trip
+    proc = _run([str(BENCH_COMPARE), _bench_artifact(tmp_path, 1, 100.0),
+                 "--runs", str(runs)])
+    assert proc.returncode == 0, proc.stdout
+
+
 def test_bench_compare_never_gates_p50_latency(tmp_path):
     """The median moves with the max_wait batching knob by design: charted
     only (UNGATED_SUFFIXES), in either direction."""
@@ -267,8 +290,11 @@ def test_lint_sh_chains_both_gates(tmp_path):
         # executables — covered by tests/test_zserve.py's self-test.
         # CHAOS=0: the chaos drill runs every scenario twice — covered by
         # tests/test_zchaos.py (scenario-level + slow CLI test).
+        # MESH_SWEEP=0: the mesh-sweep smoke compiles two sweep
+        # executables — covered by tests/test_zzpartition.py.
         env={**os.environ, "BLOCKSIM_RUNS_JSONL": str(runs),
-             "WARM_BENCH": "0", "GRAPH": "0", "SERVE": "0", "CHAOS": "0"},
+             "WARM_BENCH": "0", "GRAPH": "0", "SERVE": "0", "CHAOS": "0",
+             "MESH_SWEEP": "0"},
     )
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
     assert "jaxlint" in proc.stdout and "no regression" in proc.stdout
@@ -281,6 +307,8 @@ def test_lint_sh_chains_both_gates(tmp_path):
     assert '"${SERVE:-1}"' in script
     assert "tools/chaos_drill.py --quick" in script
     assert '"${CHAOS:-1}"' in script
+    assert "tools/mesh_sweep_bench.py --quick" in script
+    assert '"${MESH_SWEEP:-1}"' in script
     recs = [json.loads(ln) for ln in runs.read_text().strip().splitlines()]
     lint_recs = [r for r in recs if r.get("metric") == "jaxlint_new_findings"]
     assert lint_recs and lint_recs[-1]["value"] == 0

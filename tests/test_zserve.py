@@ -284,7 +284,7 @@ def test_prewarm_covers_capped_bucket(monkeypatch):
     inline."""
     seen = []
 
-    def fake_run_batch(reqs, max_batch):
+    def fake_run_batch(reqs, max_batch, **kw):
         seen.append(len(reqs))
         return [(r, {"status": "ok"}) for r in reqs]
 

@@ -320,6 +320,65 @@ def test_write_baseline_roundtrip_preserves_justifications(tmp_path):
     assert doc["entries"][key]["justification"] == "measured OK in PR N"
 
 
+def test_prune_baseline_drops_retired_budgets_and_fixed_entries(tmp_path):
+    """--prune-baseline hygiene: budgets for programs no longer in the
+    catalog drop, finding entries shrink to what the audit still produces
+    (fixed entries drop), live budget values and justifications survive
+    UNTOUCHED — pruning never re-pins."""
+    path = str(tmp_path / "GRAPH_BASELINE.json")
+    live_key = ("slow-lowering-confirmed", "live", "scatter-add")
+    old = {
+        "budgets": {
+            "live": {"flops": 123.0, "bytes": 456.0},     # kept verbatim
+            "retired": {"flops": 1.0, "bytes": 2.0},      # program gone
+        },
+        "entries": {
+            live_key: {"count": 3, "justification": "measured OK"},
+            ("slow-lowering-confirmed", "retired", "scatter"):
+                {"count": 2, "justification": "stale"},
+        },
+        "tolerance": 0.25,
+    }
+    res = _result({"live": _report(name="live", flops=999.0)})
+    # the audit still produces only ONE of the entry's three findings
+    res.findings = [audit.GraphFinding(
+        rule="slow-lowering-confirmed", program="live", detail="scatter-add",
+        message="m", count=1,
+    )]
+    info = audit.prune_baseline(path, res, old)
+    assert info["dropped_budgets"] == ["retired"]
+    assert info["dropped_entries"] == [
+        ("slow-lowering-confirmed", "retired", "scatter")
+    ]
+    assert info["shrunk_entries"] == [live_key]
+    doc = audit.load_baseline(path)
+    # live budget kept at its OLD pin, not the measured 999
+    assert doc["budgets"] == {"live": {"flops": 123.0, "bytes": 456.0}}
+    assert doc["entries"] == {
+        live_key: {"count": 1, "justification": "measured OK"}
+    }
+
+
+def test_prune_baseline_cli_requires_full_run_and_baseline(tmp_path):
+    """The CLI guards: --prune-baseline refuses subset runs and a missing
+    baseline file (exit 2) rather than silently rewriting the wrong
+    thing."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "blockchain_simulator_tpu.lint.graph",
+         "--prune-baseline", "--only", "sim.pbft_tick"],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+    )
+    assert proc.returncode == 2
+    assert "full catalog run" in proc.stderr
+    proc = subprocess.run(
+        [sys.executable, "-m", "blockchain_simulator_tpu.lint.graph",
+         "--prune-baseline", "--baseline", str(tmp_path / "missing.json")],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+    )
+    assert proc.returncode == 2
+    assert "existing baseline" in proc.stderr
+
+
 def test_committed_baseline_pins_every_budgeted_program():
     doc = audit.load_baseline(audit.default_baseline_path())
     budgeted = {s.program for s in prog_mod.build_catalog() if s.budget}

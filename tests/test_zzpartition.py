@@ -1,0 +1,306 @@
+"""Mesh-partitioned execution: the partition-rules layer + mesh sweeps
+(parallel/partition.py, sweep.mesh_dyn_batched_fn, serve mesh dispatch).
+
+Pins the partition layer's contracts:
+
+- **Rule matching**: regex path patterns → rank-padded PartitionSpec
+  pytrees (scalars never partitioned, unmatched non-scalars raise, first
+  match wins) and the ``partition()`` door's argument validation.
+- **Mesh-sweep bit-equality** (exact sampler): the mesh-partitioned sweep
+  vs the single-device PR 4 path, the mesh-size-1 degenerate case vs plain
+  vmap, and uneven grids (points % devices != 0) through the padding
+  lanes — all row-for-row bit-equal, all ONE executable per (fault
+  structure, mesh).
+- **Single door**: the four shard.py sim wrappers route through
+  parallel/partition.py — no direct ``shard_map`` call site outside it.
+- **Serving compatibility**: a batched serving flush dispatches onto the
+  mesh-sharded registry entry (ROADMAP item 1b) and the registry/stats
+  surfaces expose per-entry mesh descriptors.
+
+Late-alphabet file on purpose: the tier-1 870 s window fills from the
+front of the alphabet (ROADMAP.md), so the compile-heavy pins here must
+not displace the early suites.  Points are shaped so the mesh dispatches
+share one 8-lane lowering across tests.
+"""
+
+import dataclasses
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from blockchain_simulator_tpu.models.base import canonical_fault_cfg
+from blockchain_simulator_tpu.parallel import partition
+from blockchain_simulator_tpu.parallel.mesh import NODES_AXIS, make_mesh
+from blockchain_simulator_tpu.parallel.sweep import (
+    dyn_batched_fn,
+    mesh_dyn_batched_fn,
+    run_byzantine_sweep,
+    run_dyn_points,
+)
+from blockchain_simulator_tpu.utils import aotcache
+from blockchain_simulator_tpu.utils.config import SimConfig
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+CFG = SimConfig(protocol="pbft", n=8, sim_ms=200, stat_sampler="exact")
+CANON = canonical_fault_cfg(
+    CFG.with_(faults=dataclasses.replace(CFG.faults, n_byzantine=1))
+)
+# 6 points over 3 fault levels x 2 seeds: pads to one 8-lane mesh dispatch
+PTS6 = [
+    (CFG.with_(faults=dataclasses.replace(CFG.faults, n_byzantine=f)), seed)
+    for f in (0, 1, 2) for seed in (0, 1)
+]
+
+
+def _rows_equal(a, b):
+    return all(
+        {k: str(v) for k, v in x.items()} == {k: str(v) for k, v in y.items()}
+        for x, y in zip(a, b)
+    )
+
+
+@pytest.fixture(scope="module")
+def single_rows():
+    """The single-device reference rows for PTS6 (computed once)."""
+    return run_dyn_points(CANON, PTS6, record=False)
+
+
+# --------------------------------------------------------- rule matching ---
+
+
+def test_match_partition_rules_names_and_padding():
+    tree = {
+        "state": {"v": jnp.zeros((4, 3)), "commit_t": jnp.zeros((4,))},
+        "total": jnp.zeros((2, 4, 3)),
+    }
+    specs = partition.match_partition_rules(
+        (
+            (r"(^|/)total$", P(None, NODES_AXIS)),
+            (r"^state/", P(NODES_AXIS)),
+        ),
+        tree,
+    )
+    assert specs["state"]["v"] == P(NODES_AXIS, None)  # rank-padded
+    assert specs["state"]["commit_t"] == P(NODES_AXIS)
+    assert specs["total"] == P(None, NODES_AXIS, None)
+
+
+def test_match_partition_rules_scalars_never_partitioned():
+    tree = {"x": jnp.zeros(()), "one": jnp.zeros((1,)), "v": jnp.zeros((4,))}
+    specs = partition.match_partition_rules(((r".*", P(NODES_AXIS)),), tree)
+    assert specs["x"] == P() and specs["one"] == P()
+    assert specs["v"] == P(NODES_AXIS)
+
+
+def test_match_partition_rules_first_match_wins_and_raises():
+    tree = {"a": jnp.zeros((4,)), "weird": jnp.zeros((4,))}
+    with pytest.raises(ValueError, match="no partition rule matched"):
+        partition.match_partition_rules(((r"^a$", P(NODES_AXIS)),), tree)
+    specs = partition.match_partition_rules(
+        ((r"^a$", partition.REPLICATED), (r".*", P(NODES_AXIS))), tree
+    )
+    assert specs["a"] == P(None) and specs["weird"] == P(NODES_AXIS)
+    with pytest.raises(ValueError, match="rank-1"):
+        partition.match_partition_rules(
+            ((r".*", P(None, NODES_AXIS)),), {"a": jnp.zeros((4,))}
+        )
+
+
+def test_partition_argument_validation():
+    mesh = make_mesh(n_node_shards=2, n_sweep=1, devices=jax.devices()[:2])
+    fn = lambda x: x  # noqa: E731
+    with pytest.raises(ValueError, match="not both"):
+        partition.partition(fn, mesh, in_shardings=P(), in_specs=P())
+    with pytest.raises(ValueError, match="both in_shardings"):
+        partition.partition(fn, mesh, in_shardings=P())
+    with pytest.raises(ValueError, match="needs in_shardings"):
+        partition.partition(fn, mesh)
+    with pytest.raises(ValueError, match="wrap_jit"):
+        partition.partition(fn, mesh, in_shardings=P(), out_shardings=P(),
+                            wrap_jit=False)
+
+
+def test_pad_points():
+    padded, n = partition.pad_points([1, 2, 3], 8)
+    assert padded == [1, 2, 3, 3, 3, 3, 3, 3] and n == 3
+    padded, n = partition.pad_points([1, 2], 2)
+    assert padded == [1, 2] and n == 2  # already even: no padding
+    with pytest.raises(ValueError):
+        partition.pad_points([], 4)
+
+
+# ------------------------------------------------------ mesh sweep pins ---
+
+
+def test_mesh_sweep_bit_equal_one_executable(single_rows):
+    """The tentpole pin: a mesh-partitioned dispatch of the (f, seed) grid
+    is bit-equal to the single-device PR 4 path under the exact sampler,
+    through exactly ONE new executable (the registry key carries the
+    mesh)."""
+    mesh = make_mesh(n_node_shards=1, n_sweep=8)
+    before = aotcache.registry.stats()["misses"]
+    rows_mesh = run_dyn_points(CANON, PTS6, record=False, mesh=mesh)
+    added = aotcache.registry.stats()["misses"] - before
+    assert len(rows_mesh) == 6
+    assert _rows_equal(rows_mesh, single_rows)
+    assert added == 1  # one partition-dyn-sweep entry, nothing else
+
+
+def test_mesh_sweep_uneven_grid_padding(single_rows):
+    """points % devices != 0: the tail padding lanes are dispatched and
+    discarded — row count and values unchanged."""
+    mesh = make_mesh(n_node_shards=1, n_sweep=8)
+    pts5 = PTS6[:5]  # 5 % 8 != 0 -> pads to one 8-lane dispatch
+    rows = run_dyn_points(CANON, pts5, record=False, mesh=mesh)
+    assert len(rows) == 5
+    assert _rows_equal(rows, single_rows[:5])
+
+
+def test_mesh_size_one_degenerates_to_plain_vmap(single_rows):
+    """A 1-device mesh IS the single-device path: the factory returns the
+    very same ``sweep-batched-dynf`` program object (bit-equality is
+    structural, not just numerical)."""
+    mesh1 = make_mesh(n_node_shards=1, n_sweep=1, devices=jax.devices()[:1])
+    assert mesh_dyn_batched_fn(CANON, mesh1) is dyn_batched_fn(CANON)
+    rows = run_dyn_points(CANON, PTS6, record=False, mesh=mesh1)
+    assert _rows_equal(rows, single_rows)
+
+
+def test_mesh_byzantine_sweep_end_to_end():
+    """run_byzantine_sweep(mesh=...) — the user-facing sweep entry point —
+    matches its single-device rows, including the f/seed row labels."""
+    mesh = make_mesh(n_node_shards=1, n_sweep=8)
+    kw = dict(f_values=(0, 1, 2), seeds=(0, 1), forge=False)
+    rows_mesh = run_byzantine_sweep(CFG, mesh=mesh, **kw)
+    rows_single = run_byzantine_sweep(CFG, **kw)
+    assert _rows_equal(rows_mesh, rows_single)
+    assert [r["f"] for r in rows_mesh] == [0, 0, 1, 1, 2, 2]
+
+
+def test_node_axis_sharding_pjit_arm():
+    """nodes axis > 1: the explicit-sharding pjit arm (GSPMD partitions
+    the vmapped scan; node dim rides the nodes axis) — bit-equal under the
+    exact sampler."""
+    cfg = SimConfig(protocol="pbft", n=16, sim_ms=200, stat_sampler="exact")
+    canon = canonical_fault_cfg(
+        cfg.with_(faults=dataclasses.replace(cfg.faults, n_byzantine=1))
+    )
+    pts = [
+        (cfg.with_(faults=dataclasses.replace(cfg.faults, n_byzantine=i % 3)),
+         i)
+        for i in range(4)
+    ]
+    mesh22 = make_mesh(n_node_shards=2, n_sweep=2, devices=jax.devices()[:4])
+    rows_mesh = run_dyn_points(canon, pts, record=False, mesh=mesh22)
+    rows_single = run_dyn_points(canon, pts, record=False)
+    assert _rows_equal(rows_mesh, rows_single)
+
+
+# ------------------------------------------------- single-door contract ---
+
+
+def test_no_shard_map_call_sites_outside_partition():
+    """The acceptance pin: parallel/partition.py is the only module that
+    invokes shard_map (everything else routes through the layer)."""
+    pkg = REPO / "blockchain_simulator_tpu"
+    offenders = []
+    for path in sorted(pkg.rglob("*.py")):
+        if path.name == "partition.py":
+            continue
+        for i, line in enumerate(path.read_text().splitlines(), 1):
+            code = line.split("#", 1)[0]
+            if "shard_map(" in code and "import" not in code:
+                offenders.append(f"{path.relative_to(REPO)}:{i}")
+    assert offenders == [], offenders
+    import blockchain_simulator_tpu.parallel.shard as shard_mod
+
+    assert not hasattr(shard_mod, "_shard_map")
+    assert callable(partition._shard_map)
+
+
+def test_shard_rule_declarations_match_legacy_specs():
+    """The thin rule declarations reproduce the hand-rolled specs the
+    wrappers used before the layer (same sharded-sim tests stay green, so
+    the specs must be identical tree-for-tree)."""
+    from blockchain_simulator_tpu.models import pbft_round
+    from blockchain_simulator_tpu.parallel import shard
+
+    state0, bufs0 = jax.eval_shape(
+        lambda: pbft_round.init(CFG, jax.random.key(0))
+    )
+    state_spec = shard.state_specs(state0, pbft_round.GLOBAL_FIELDS)
+
+    def legacy(path, x):
+        name = path[-1].name if hasattr(path[-1], "name") else None
+        if name in pbft_round.GLOBAL_FIELDS or x.ndim == 0:
+            return P(*([None] * x.ndim))
+        return P(NODES_AXIS, *([None] * (x.ndim - 1)))
+
+    expect = jax.tree_util.tree_map_with_path(legacy, state0)
+    flat_a = jax.tree.leaves(
+        state_spec, is_leaf=lambda s: isinstance(s, P))
+    flat_b = jax.tree.leaves(expect, is_leaf=lambda s: isinstance(s, P))
+    assert [tuple(s) for s in flat_a] == [tuple(s) for s in flat_b]
+
+
+# -------------------------------------------------- serving + registry ---
+
+
+def test_serve_dispatch_on_mesh_entry():
+    """A batched serving flush dispatches onto the mesh-sharded registry
+    entry (ROADMAP item 1b): same responses as the single-device dispatch,
+    with the mesh spec recorded in the batch block."""
+    from blockchain_simulator_tpu.serve import dispatch, schema
+
+    mesh = make_mesh(n_node_shards=1, n_sweep=8)
+    obj = {"protocol": "pbft", "n": 8, "sim_ms": 200,
+           "stat_sampler": "exact",
+           "faults": {"n_byzantine": 1}}
+
+    def reqs():
+        out = []
+        for i in (0, 1):
+            r = schema.parse_request(dict(obj), f"mesh-{i}",
+                                     default_timeout_s=30.0)
+            r.seed = i
+            out.append(r)
+        return out
+
+    res_mesh = dispatch.run_batch(reqs(), 8, mesh=mesh)
+    res_plain = dispatch.run_batch(reqs(), 8)
+    assert all(resp["status"] == "ok" for _, resp in res_mesh)
+    for (_, a), (_, b) in zip(res_mesh, res_plain):
+        assert a["metrics"] == b["metrics"]  # bit-equal metrics
+        assert a["batch"]["mode"] == "batched"
+    assert res_mesh[0][1]["batch"]["mesh"] == {"sweep": 8, "nodes": 1}
+    assert "mesh" not in res_plain[0][1]["batch"]
+
+
+def test_registry_mesh_descriptors():
+    """stats_snapshot()/manifest() expose the mesh spec of registry
+    entries (the tolerant-reader schema bump)."""
+    reg = aotcache.ExecutableRegistry()
+    mesh = make_mesh(n_node_shards=1, n_sweep=8)
+    reg.get("plain", (CFG,), {}, lambda *_: object())
+    reg.get("meshed", (CFG, mesh), {}, lambda *_: object())
+    snap = reg.stats_snapshot()
+    assert snap["mesh"]["plain"] == {"none": 1}
+    assert snap["mesh"]["meshed"] == {"sweep=8,nodes=1": 1}
+    assert reg.manifest()["mesh"] == "sweep=8,nodes=1"
+    reg.get("plain", (CFG,), {}, lambda *_: object())  # hit refreshes
+    assert reg.manifest()["mesh"] is None
+
+
+def test_server_stats_expose_mesh():
+    from blockchain_simulator_tpu.serve.server import ScenarioServer
+
+    mesh = make_mesh(n_node_shards=1, n_sweep=8)
+    srv = ScenarioServer(start=False, mesh=mesh)
+    st = srv.stats()
+    assert st["mesh"] == {"sweep": 8, "nodes": 1}
+    assert "mesh" in st["cache"]  # the registry snapshot rides along
+    assert ScenarioServer(start=False).stats()["mesh"] is None

@@ -87,6 +87,23 @@ if [ "${CHAOS:-1}" != "0" ]; then
     fi
 fi
 
+# Mesh-sweep smoke (tools/mesh_sweep_bench.py --quick): a small fault
+# grid dispatched through the mesh-partitioned sweep executable
+# (parallel/partition.py) on the 8-virtual-device CPU mesh — rows must be
+# bit-equal to the single-device path and compile exactly ONE executable;
+# lands sweep_points_per_s in runs.jsonl where bench_compare gates it
+# higher-is-better.  MESH_SWEEP=0 skips (~1 min of compile on this box);
+# the full-scale artifact run is `python tools/mesh_sweep_bench.py`.
+if [ "${MESH_SWEEP:-1}" != "0" ]; then
+    echo "== mesh sweep smoke =="
+    python tools/mesh_sweep_bench.py --quick
+    mesh_rc=$?
+    if [ "$mesh_rc" -ne 0 ]; then
+        echo "lint.sh: mesh sweep smoke FAILED (rc=$mesh_rc)" >&2
+        rc=1
+    fi
+fi
+
 echo "== bench_compare =="
 if [ -n "${BLOCKSIM_RUNS_JSONL:-}" ] && [ -f "${BLOCKSIM_RUNS_JSONL}" ]; then
     python tools/bench_compare.py --runs "${BLOCKSIM_RUNS_JSONL}" "$@"

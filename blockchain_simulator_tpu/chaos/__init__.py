@@ -21,6 +21,14 @@ kept its accounting promises while the faults flew:
 cache-corrupt, health-flap, batcher-kill, queue-storm, poison-request,
 crash-restart) and pins that each runs identically twice under one chaos
 seed; README "Chaos drills" is the operator doc.
+
+The FLEET scenarios (:mod:`~blockchain_simulator_tpu.chaos.
+fleet_scenarios`, ``tools/fleet_bench.py``) extend the same discipline to
+the replicated serving tier: replica death mid-traffic with WAL handoff,
+slow-replica hedged failover, router retry storms, and double-claim
+races — checked by :func:`~blockchain_simulator_tpu.chaos.invariants.
+check_fleet` (exactly one terminal outcome per admission fleet-wide, each
+handed-off id replayed exactly once, WAL leases exclusive).
 """
 
 from blockchain_simulator_tpu.chaos.inject import (  # noqa: F401
@@ -32,6 +40,7 @@ from blockchain_simulator_tpu.chaos.inject import (  # noqa: F401
 )
 from blockchain_simulator_tpu.chaos.invariants import (  # noqa: F401
     Ledger,
+    check_fleet,
     check_server,
     registry_monotone,
 )

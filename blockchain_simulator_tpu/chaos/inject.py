@@ -31,7 +31,12 @@ Registered sites (grep ``chaos_point(`` for ground truth):
   request);
 - ``serve.batcher`` — the ScenarioServer batcher loop, once per
   iteration after the arrivals drain (where :class:`ChaosKill` simulates
-  a dead batcher thread for the supervision drill).
+  a dead batcher thread for the supervision drill);
+- ``fleet.send`` — serve/router.py, before each POST to a replica (ctx
+  carries ``replica`` and ``req_id``: a drill can slow or fail the path
+  to ONE replica — the hedged-failover scenario);
+- ``fleet.handoff`` — serve/router.py, at the start of a dead replica's
+  WAL handoff (ctx carries ``replica``).
 """
 
 from __future__ import annotations
@@ -111,14 +116,19 @@ class ChaosController:
         thread-death injection (only meaningful at ``serve.batcher``)."""
         self._arm(site, _Action("fail", count=n, exc=ChaosKill))
 
-    def hang_next(self, site: str, seconds: float, n: int = 1) -> None:
+    def hang_next(self, site: str, seconds: float, n: int = 1,
+                  match=None) -> None:
         """Sleep ``seconds`` on the next ``n`` firings (a bounded stand-in
-        for a wedged dispatch: long relative to request timeouts)."""
-        self._arm(site, _Action("hang", count=n, sleep_s=float(seconds)))
+        for a wedged dispatch: long relative to request timeouts).
+        ``match(ctx)`` narrows the firings (e.g. one fleet replica)."""
+        self._arm(site, _Action("hang", count=n, sleep_s=float(seconds),
+                                match=match))
 
-    def slow_next(self, site: str, seconds: float, n: int = 1) -> None:
+    def slow_next(self, site: str, seconds: float, n: int = 1,
+                  match=None) -> None:
         """Same mechanics as hang, logged distinctly: latency, not loss."""
-        self._arm(site, _Action("slow", count=n, sleep_s=float(seconds)))
+        self._arm(site, _Action("slow", count=n, sleep_s=float(seconds),
+                                match=match))
 
     def poison(self, site: str, req_id: str, exc=ChaosFault) -> None:
         """Raise forever at ``site`` whenever ``ctx['req_id'] == req_id`` —

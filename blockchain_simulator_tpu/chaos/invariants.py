@@ -111,6 +111,87 @@ def _stats_balance(stats: dict) -> list[str]:
     return violations
 
 
+def ledger_complete(ledger: Ledger) -> list[str]:
+    """Invariant 1, client side: exactly one terminal outcome per
+    submission attempt — zero is a lost request, two is a double answer
+    (shared by :func:`check_server` and :func:`check_fleet`)."""
+    violations = []
+    for req_id, attempts in ledger.attempts.items():
+        for i, slot in enumerate(attempts):
+            if len(slot) != 1:
+                violations.append(
+                    f"request {req_id!r} attempt {i} has {len(slot)} "
+                    f"terminal outcomes {slot} (exactly one required)"
+                )
+    return violations
+
+
+def check_fleet(
+    ledger: Ledger | None,
+    router_stats: dict,
+    log_path=None,
+    handoff_ids=(),
+) -> list[str]:
+    """The fleet-wide accounting contracts (serve/router.py + fleet.py):
+
+    1. **Exactly one terminal outcome per admission** — client-side
+       (ledger) and router-side: every received request is answered
+       exactly once (``received == Σ answered``; late duplicate answers
+       are *dropped*, counted in ``late_answers``, never delivered).
+    2. **Handoff exactly once fleet-wide** — each dead-WAL id appears in
+       exactly ONE completed handoff's replay set, and (``log_path``)
+       has exactly ONE access-log line marked ``"replayed": true`` — no
+       id is replayed twice, none is lost.
+    3. **Claims are exclusive** — no WAL reports more than one claiming
+       handoff (the lease rule serve/fleet.py enforces on disk).
+    """
+    violations: list[str] = []
+    if ledger is not None:
+        violations += ledger_complete(ledger)
+    received = router_stats.get("received", 0)
+    answered = sum((router_stats.get("answered") or {}).values())
+    if received != answered:
+        violations.append(
+            f"router accounting broken: received={received} but "
+            f"answered={answered} ({router_stats.get('answered')})"
+        )
+    handoffs = router_stats.get("handoffs") or []
+    replay_counts: dict[str, int] = {}
+    claims_by_wal: dict[str, int] = {}
+    for h in handoffs:
+        if h.get("claimed"):
+            wal = str(h.get("wal"))
+            claims_by_wal[wal] = claims_by_wal.get(wal, 0) + 1
+        for rid in list(h.get("replayed") or []) \
+                + list(h.get("redispatched") or []):
+            replay_counts[str(rid)] = replay_counts.get(str(rid), 0) + 1
+    for wal, n in claims_by_wal.items():
+        if n > 1:
+            violations.append(
+                f"WAL {wal!r} claimed by {n} handoffs (lease must win "
+                f"exactly once)")
+    for rid, n in replay_counts.items():
+        if n > 1:
+            violations.append(
+                f"id {rid!r} replayed {n} times across handoffs")
+    for rid in handoff_ids:
+        if replay_counts.get(str(rid), 0) != 1:
+            violations.append(
+                f"handoff id {rid!r} replayed "
+                f"{replay_counts.get(str(rid), 0)} times (want exactly 1)")
+    if log_path is not None:
+        recs = obs.read_jsonl(log_path)
+        for rid in handoff_ids:
+            marked = sum(1 for r in recs
+                         if str(r.get("id")) == str(rid)
+                         and r.get("replayed") is True)
+            if marked != 1:
+                violations.append(
+                    f"handoff id {rid!r} has {marked} replayed-marked "
+                    f"access-log lines (want exactly 1)")
+    return violations
+
+
 def check_server(
     ledger: Ledger | None,
     stats: dict,
@@ -130,13 +211,7 @@ def check_server(
     """
     violations: list[str] = []
     if ledger is not None:
-        for req_id, attempts in ledger.attempts.items():
-            for i, slot in enumerate(attempts):
-                if len(slot) != 1:
-                    violations.append(
-                        f"request {req_id!r} attempt {i} has {len(slot)} "
-                        f"terminal outcomes {slot} (exactly one required)"
-                    )
+        violations += ledger_complete(ledger)
     violations += _stats_balance(stats)
     if log_path is not None:
         recs = obs.read_jsonl(log_path)

@@ -11,6 +11,7 @@ from blockchain_simulator_tpu.serve.schema import (  # noqa: F401
     DispatchFailedError,
     InvalidRequestError,
     QueueFullError,
+    ReplicaLostError,
     RequestTimeoutError,
     ScenarioRequest,
     ServeError,
@@ -24,3 +25,7 @@ from blockchain_simulator_tpu.serve.server import (  # noqa: F401
     ScenarioServer,
 )
 from blockchain_simulator_tpu.serve.wal import WriteAheadLog  # noqa: F401
+
+# Fleet layer (serve/fleet.py + serve/router.py): imported lazily by
+# consumers — FleetRouter pulls the HTTP/urllib machinery and FleetManager
+# the subprocess layer, neither of which the in-process serving core needs.

@@ -317,6 +317,19 @@ def main(argv=None) -> int:
                    help="request template whose batch group is compiled "
                         "(or AOT-cache-loaded) across every bucket size "
                         "before serving starts")
+    p.add_argument("--prewarm-from", default=None, metavar="RUNS_JSONL",
+                   help="prewarm the group/bucket mix OBSERVED in a prior "
+                        "access log (each served line carries its "
+                        "re-submittable scenario template) instead of the "
+                        "fixed bucket ladder")
+    p.add_argument("--prewarm-groups", type=int, default=8,
+                   help="--prewarm-from warms at most this many of the "
+                        "most-frequent observed batch groups")
+    p.add_argument("--replica-id", default=None, metavar="ID",
+                   help="fleet identity (serve/fleet.py): labels health-"
+                        "log seeding so N replicas sharing one "
+                        "HEALTH.jsonl read only their own verdicts, and "
+                        "rides the READY line/stats")
     p.add_argument("--mesh-sweep", type=int, default=0, metavar="N",
                    help="shard batched dispatches over an N-device sweep "
                         "mesh (parallel/partition.py; 0 = single-device). "
@@ -376,6 +389,7 @@ def main(argv=None) -> int:
         breaker_threshold=args.breaker_threshold,
         breaker_cooldown_s=args.breaker_cooldown_s,
         mesh=mesh,
+        replica=args.replica_id,
     )
     if args.prewarm:
         try:
@@ -384,12 +398,26 @@ def main(argv=None) -> int:
         except Exception as e:
             print(json.dumps({"prewarm_error": f"{type(e).__name__}: {e}"}),
                   flush=True)
+    if args.prewarm_from:
+        try:
+            plan = server.prewarm_from(args.prewarm_from,
+                                       max_groups=args.prewarm_groups)
+            print(json.dumps({"prewarm_from": {
+                g: {"requests": rec["requests"], "buckets": rec["buckets"]}
+                for g, rec in plan.items()
+            }}), flush=True)
+        except Exception as e:
+            print(json.dumps(
+                {"prewarm_from_error": f"{type(e).__name__}: {e}"}),
+                flush=True)
     httpd = make_httpd(server, args.host, args.port)
     print("READY " + json.dumps({
         "host": args.host, "port": httpd.server_address[1],
         "max_batch": server.max_batch, "max_wait_ms": server.max_wait_ms,
         "max_queue": server.max_queue, "wal": args.wal,
         "replayed": server._wal_replayed_at_start if args.wal else 0,
+        "wal_claimed_by": server._wal_claimed_by if args.wal else None,
+        "replica": args.replica_id,
         "mesh": server.stats()["mesh"],
     }), flush=True)
     try:

@@ -150,6 +150,17 @@ class ShuttingDownError(ServeError):
     kind = "shutting-down"
 
 
+class ReplicaLostError(ServeError):
+    """Fleet routing (serve/router.py) lost the replica carrying this
+    request and no peer could answer it: the replica died and its WAL
+    lease is held elsewhere, or no live replica remains.  502, the
+    gateway's own failure class — retryable by the client, and always
+    access-logged before the caller sees it."""
+
+    code = 502
+    kind = "replica-lost"
+
+
 # ---------------------------------------------------------------- requests
 
 
@@ -251,6 +262,27 @@ def parse_request(obj, req_id: str, default_timeout_s: float = 30.0,
         seed=seed,
         timeout_s=timeout_s,
     )
+
+
+def scenario_template(cfg: SimConfig, seed: int | None = None) -> dict:
+    """The compact re-submittable request template of one config: only
+    the non-default SimConfig/FaultConfig fields (plus ``seed`` when
+    given).  ``parse_request(scenario_template(cfg))`` reconstructs the
+    same canonical batch group — the access log records this per served
+    request so ``--prewarm-from`` can warm tomorrow's daemon from the
+    group/bucket mix actually observed yesterday (serve/server.py)."""
+    d = dataclasses.asdict(cfg)
+    cfg_defaults = dataclasses.asdict(_CFG_DEFAULTS)
+    out = {k: v for k, v in d.items()
+           if k in _CFG_FIELDS and v != cfg_defaults.get(k)}
+    fault_defaults = dataclasses.asdict(_FAULT_DEFAULTS)
+    faults = {k: v for k, v in (d.get("faults") or {}).items()
+              if v != fault_defaults.get(k)}
+    if faults:
+        out["faults"] = faults
+    if seed is not None:
+        out["seed"] = int(seed)
+    return out
 
 
 # --------------------------------------------------------------- responses

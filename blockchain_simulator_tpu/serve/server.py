@@ -182,6 +182,7 @@ class ScenarioServer:
         breaker_cooldown_s: float = 30.0,
         restart_backoff_s: float = 0.05,
         mesh=None,
+        replica: str | None = None,
     ):
         if max_batch < 1 or max_queue < 1:
             raise ValueError("max_batch and max_queue must be >= 1")
@@ -197,6 +198,10 @@ class ScenarioServer:
         self.breaker_cooldown_s = float(breaker_cooldown_s)
         self.restart_backoff_s = float(restart_backoff_s)
 
+        # fleet identity (serve/fleet.py): labels this replica's health
+        # seeding so N replicas sharing one HEALTH.jsonl read only their
+        # own (or unlabeled) verdicts instead of each other's
+        self.replica = str(replica) if replica else None
         self._arrivals: queue.Queue = queue.Queue()
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
@@ -205,7 +210,8 @@ class ScenarioServer:
         if health_log:
             from blockchain_simulator_tpu.utils import health as health_mod
 
-            rec = health_mod.latest_verdict(health_log)
+            rec = health_mod.latest_verdict(health_log,
+                                            replica=self.replica)
             if rec is not None:
                 self._health = {"verdict": rec["verdict"],
                                 "source": health_log}
@@ -228,11 +234,21 @@ class ScenarioServer:
 
         self._wal: WriteAheadLog | None = None
         self._wal_replayed_at_start = 0
+        self._wal_claimed_by: str | None = None
         if wal_path:
             self._wal = WriteAheadLog(wal_path, sync=wal_sync)
             self._quarantine |= self._wal.quarantined_ids()
-            self._wal.compact()
-            self._replay_wal()
+            from blockchain_simulator_tpu.serve import fleet
+
+            self._wal_claimed_by = fleet.claim_owner(wal_path)
+            if self._wal_claimed_by is None:
+                self._wal.compact()
+                self._replay_wal()
+            # else: a router holds this WAL's lease (serve/fleet.py) — the
+            # pending ids are being replayed on a peer RIGHT NOW, so a
+            # restarting replica must not replay them a second time; it
+            # still serves (and journals) new traffic on the same file.
+            # Compaction is skipped too: the lease holder is reading it.
         if start:
             self.start()
 
@@ -535,7 +551,13 @@ class ScenarioServer:
                 by_kind = self._stats["rejected"]
                 by_kind[counter] = by_kind.get(counter, 0) + 1
         try:
-            obs.record_run(resp, req.cfg)
+            # the logged copy carries the re-submittable request template
+            # (non-default fields only) so --prewarm-from can replay the
+            # observed group/bucket mix; the client response stays as-is
+            log_rec = dict(resp)
+            log_rec["scenario"] = schema.scenario_template(req.cfg,
+                                                           req.seed)
+            obs.record_run(log_rec, req.cfg)
         except Exception:
             pass  # the access log must never block the answer
         self._wal_done(req.req_id, resp.get("code"))
@@ -677,11 +699,14 @@ class ScenarioServer:
                 "mesh": (_mesh_shape_dict(self.mesh)
                          if self.mesh is not None else None),
             }
+            if self.replica is not None:
+                rec["replica"] = self.replica
             if self._wal is not None:
                 rec["wal"] = {
                     "path": self._wal.path,
                     "sync": self._wal.sync,
                     "replayed_at_start": self._wal_replayed_at_start,
+                    "claimed_by": self._wal_claimed_by,
                 }
         rec["cache"] = aotcache.registry.stats_snapshot()
         return rec
@@ -707,22 +732,64 @@ class ScenarioServer:
             # so that capped bucket is dispatchable too and must be warm
             sizes.append(self.max_batch)
         for size in sizes:
-            reqs = []
-            for i in range(size):
-                r = schema.parse_request(
-                    dict(obj), f"prewarm-{size}-{i}",
-                    default_timeout_s=self.default_timeout_s,
-                )
-                r.seed = i
-                r.submitted = time.monotonic()
-                reqs.append(r)
-            t0 = time.monotonic()
-            results = dispatch.run_batch(reqs, self.max_batch, mesh=self.mesh)
-            walls[str(size)] = round(time.monotonic() - t0, 3)
-            for _, resp in results:
-                if resp.get("status") != "ok":
-                    raise schema.ServeError(
-                        f"prewarm dispatch failed at bucket {size}: "
-                        f"{resp.get('error')}"
-                    )
+            walls[str(size)] = self._prewarm_bucket(obj, size)
         return walls
+
+    def _prewarm_bucket(self, obj: dict, size: int) -> float:
+        """Compile/load the one executable serving ``size``-lane batches
+        of this template's group; returns the wall seconds."""
+        reqs = []
+        for i in range(size):
+            r = schema.parse_request(
+                dict(obj), f"prewarm-{size}-{i}",
+                default_timeout_s=self.default_timeout_s,
+            )
+            r.seed = i
+            r.submitted = time.monotonic()
+            reqs.append(r)
+        t0 = time.monotonic()
+        results = dispatch.run_batch(reqs, self.max_batch, mesh=self.mesh)
+        wall = round(time.monotonic() - t0, 3)
+        for _, resp in results:
+            if resp.get("status") != "ok":
+                raise schema.ServeError(
+                    f"prewarm dispatch failed at bucket {size}: "
+                    f"{resp.get('error')}"
+                )
+        return wall
+
+    def prewarm_from(self, log_path: str, max_groups: int = 8) -> dict:
+        """Prewarm from OBSERVED traffic instead of the fixed bucket
+        ladder: read a prior access log (runs.jsonl — each served line
+        carries its ``scenario`` template and its ``batch.padded`` bucket,
+        serve/server._answer), and warm, for the ``max_groups`` most
+        frequent batch groups, exactly the bucket sizes that group was
+        actually dispatched at.  Returns ``{group_hash: {"requests": n,
+        "template": {...}, "buckets": {size: wall_s}}}`` — the daemon's
+        ``--prewarm-from`` (README "Fleet serving")."""
+        groups: dict[str, dict] = {}
+        for rec in obs.read_jsonl(log_path):
+            tpl = rec.get("scenario")
+            if rec.get("status") != "ok" or not isinstance(tpl, dict):
+                continue
+            batch = rec.get("batch") or {}
+            group = batch.get("group")
+            if not group:
+                continue
+            g = groups.setdefault(group, {"requests": 0, "template": tpl,
+                                          "buckets": set()})
+            g["requests"] += 1
+            padded = batch.get("padded")
+            if isinstance(padded, int) and padded >= 1:
+                g["buckets"].add(min(padded, self.max_batch))
+        ranked = sorted(groups.items(),
+                        key=lambda kv: (-kv[1]["requests"], kv[0]))
+        out: dict[str, dict] = {}
+        for group, g in ranked[:max_groups]:
+            tpl = {k: v for k, v in g["template"].items() if k != "seed"}
+            walls = {}
+            for size in sorted(g["buckets"] or {1}):
+                walls[str(size)] = self._prewarm_bucket(dict(tpl), size)
+            out[group] = {"requests": g["requests"], "template": tpl,
+                          "buckets": walls}
+        return out

@@ -42,7 +42,8 @@ VERDICTS = ("healthy", "sick", "wedged")
 HEALTH_ENV = "BLOCKSIM_HEALTH_JSONL"
 
 
-def probe_backend(platform: str | None = None) -> dict:
+def probe_backend(platform: str | None = None,
+                  replica: str | None = None) -> dict:
     """Probe whatever backend jax resolves (or ``platform``) in-process.
 
     The probe is bench.py's historical stage 0: ``jax.default_backend()``
@@ -57,6 +58,11 @@ def probe_backend(platform: str | None = None) -> dict:
     """
     t0 = time.monotonic()
     rec: dict = {"verdict": "sick", "probe_s": None, "backend": None}
+    if replica:
+        # fleet identity: verdicts are per-PROCESS, so N replicas sharing
+        # one rolling HEALTH.jsonl must label their lines or they gate
+        # each other's admission (latest_verdict filters on this)
+        rec["replica"] = str(replica)
     try:
         import jax
 
@@ -92,6 +98,7 @@ def probe_backend_supervised(
     attempts: int = 2,
     backoff_s: float = 2.0,
     rng=None,
+    replica: str | None = None,
 ) -> dict:
     """Run the probe in a detached child; classify a silent child as
     ``wedged`` — but only after ``attempts`` probes, separated by a
@@ -116,6 +123,8 @@ def probe_backend_supervised(
             break
         time.sleep(backoff_s * (2.0 ** (attempt - 1)) * (0.5 + rng()))
     rec["supervised"] = True
+    if replica:
+        rec["replica"] = str(replica)
     return rec
 
 
@@ -197,12 +206,19 @@ def _probe_attempt(patience_s: float, env=None) -> dict:
     return rec
 
 
-def latest_verdict(path: str | None = None) -> dict | None:
+def latest_verdict(path: str | None = None,
+                   replica: str | None = None) -> dict | None:
     """Most recent verdict record from a rolling health log (explicit path,
     else ``$BLOCKSIM_HEALTH_JSONL``), or None when no log / no parseable
     verdict line exists.  Read-only and never raises: the scenario server
     (serve/) consults this at startup to decide whether admission opens
-    paused — a stale or missing log must default to serving, not crash."""
+    paused — a stale or missing log must default to serving, not crash.
+
+    ``replica`` (a fleet replica id) restricts the read to that replica's
+    own lines plus UNLABELED lines (a global probe gates everyone): N
+    replicas sharing one HEALTH.jsonl no longer clobber each other's
+    admission gating.  Without it, every verdict line counts — the
+    single-daemon behavior, unchanged."""
     from blockchain_simulator_tpu.utils import obs
 
     path = path or os.environ.get(HEALTH_ENV)
@@ -210,8 +226,12 @@ def latest_verdict(path: str | None = None) -> dict | None:
         return None
     last = None
     for rec in obs.read_jsonl(path):
-        if rec.get("verdict") in VERDICTS:
-            last = rec
+        if rec.get("verdict") not in VERDICTS:
+            continue
+        if replica is not None and rec.get("replica") is not None \
+                and str(rec.get("replica")) != str(replica):
+            continue
+        last = rec
     return last
 
 
@@ -249,21 +269,26 @@ def main(argv=None) -> int:
                         "the serve admission gate")
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. cpu) for the probe")
+    p.add_argument("--replica", default=None,
+                   help="fleet replica id to label the verdict with — "
+                        "replicas sharing one HEALTH.jsonl gate admission "
+                        "on their own lines only (serve/fleet.py)")
     p.add_argument("--log", default="HEALTH.jsonl",
                    help="rolling verdict log to append to ('' disables)")
     args = p.parse_args(argv)
 
     if args.child:
-        rec = probe_backend(platform=args.platform)
+        rec = probe_backend(platform=args.platform, replica=args.replica)
         print(json.dumps(rec), flush=True)
         return 0 if rec["verdict"] == "healthy" else 1
 
     if args.in_process:
-        rec = probe_backend(platform=args.platform)
+        rec = probe_backend(platform=args.platform, replica=args.replica)
     else:
         env = {"JAX_PLATFORMS": args.platform} if args.platform else None
         rec = probe_backend_supervised(patience_s=args.patience, env=env,
-                                       attempts=args.attempts)
+                                       attempts=args.attempts,
+                                       replica=args.replica)
     rec["ts"] = round(time.time(), 3)
     print(json.dumps(rec), flush=True)
     append_health(rec, args.log or None)

@@ -205,6 +205,24 @@ def test_bench_compare_never_gates_chaos_counters(tmp_path):
     assert "chaos_invariant_violations" in proc.stdout
 
 
+def test_bench_compare_never_gates_fleet_counters(tmp_path):
+    """The fleet drill/bench series (fleet_ prefix, tools/fleet_bench.py)
+    is charted only: fleet_invariant_violations is lower-is-better with
+    the drill's own exit gate, and fleet_rps mixes replica counts and
+    machine states across runs — neither may trip the throughput rule."""
+    runs = tmp_path / "runs.jsonl"
+    rows = []
+    for metric, vals in (("fleet_invariant_violations", (2, 0)),
+                         ("fleet_rps", (40.0, 5.0))):
+        rows += [{"metric": metric, "value": v,
+                  "manifest": {"obs_schema": 1}} for v in vals]
+    runs.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    proc = _run([str(BENCH_COMPARE), _bench_artifact(tmp_path, 1, 100.0),
+                 "--runs", str(runs)])
+    assert proc.returncode == 0, proc.stdout
+    assert "fleet_rps" in proc.stdout
+
+
 def test_bench_compare_gates_p99_latency_inverted(tmp_path):
     """serve_p99_ms is lower-is-better AND gated: an increase beyond the
     threshold is the regression; a decrease (faster serving) never trips."""
@@ -292,9 +310,11 @@ def test_lint_sh_chains_both_gates(tmp_path):
         # tests/test_zchaos.py (scenario-level + slow CLI test).
         # MESH_SWEEP=0: the mesh-sweep smoke compiles two sweep
         # executables — covered by tests/test_zzpartition.py.
+        # FLEET=0: the fleet drill runs every fleet scenario twice —
+        # covered by tests/test_zfleet.py (scenario-level + slow CLI).
         env={**os.environ, "BLOCKSIM_RUNS_JSONL": str(runs),
              "WARM_BENCH": "0", "GRAPH": "0", "SERVE": "0", "CHAOS": "0",
-             "MESH_SWEEP": "0"},
+             "MESH_SWEEP": "0", "FLEET": "0"},
     )
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
     assert "jaxlint" in proc.stdout and "no regression" in proc.stdout
@@ -309,6 +329,8 @@ def test_lint_sh_chains_both_gates(tmp_path):
     assert '"${CHAOS:-1}"' in script
     assert "tools/mesh_sweep_bench.py --quick" in script
     assert '"${MESH_SWEEP:-1}"' in script
+    assert "tools/fleet_bench.py --quick" in script
+    assert '"${FLEET:-1}"' in script
     recs = [json.loads(ln) for ln in runs.read_text().strip().splitlines()]
     lint_recs = [r for r in recs if r.get("metric") == "jaxlint_new_findings"]
     assert lint_recs and lint_recs[-1]["value"] == 0

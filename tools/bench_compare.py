@@ -131,7 +131,7 @@ def trajectory(rows: list[dict]) -> dict[str, list[dict]]:
 # tools/chaos_drill.py) are the same shape: zero is the goal, any rise
 # already fails the drill's own exit code — chart, never gate.
 UNGATED_SUFFIXES = ("_findings", "_compile_s", "_p50_ms")
-UNGATED_PREFIXES = ("graph_", "chaos_")
+UNGATED_PREFIXES = ("graph_", "chaos_", "fleet_")
 
 # Serving latency is lower-is-better AND gated: the serve smoke/bench land
 # a p99 trajectory (serve_p99_ms) whose REGRESSION is an increase, so the

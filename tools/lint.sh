@@ -15,6 +15,10 @@
 #   - the chaos drill (`tools/chaos_drill.py --quick`) runs every scripted
 #     fault scenario twice under one seed, invariant-clean and
 #     deterministic (CHAOS=0 skips);
+#   - the fleet drill (`tools/fleet_bench.py --quick`) does the same for
+#     the replicated serving tier (replica death/WAL handoff, hedged
+#     failover, retry storm, double-claim) plus a 2-replica micro-bench
+#     (FLEET=0 skips);
 #   - `tools/bench_compare.py` sees no metric drop beyond its threshold.
 #
 # When $BLOCKSIM_RUNS_JSONL is set the lint runs themselves land in
@@ -83,6 +87,25 @@ if [ "${CHAOS:-1}" != "0" ]; then
     chaos_rc=$?
     if [ "$chaos_rc" -ne 0 ]; then
         echo "lint.sh: chaos drill FAILED (rc=$chaos_rc)" >&2
+        rc=1
+    fi
+fi
+
+# Fleet drill + micro-bench (tools/fleet_bench.py --quick): every fleet
+# chaos scenario (replica death/WAL handoff, hedged failover, retry
+# storm, double-claim race) run twice under one seed — invariant-clean
+# and byte-equal — plus a 2-replica in-process micro-bench; lands
+# fleet_invariant_violations / fleet_rps in runs.jsonl (charted, never
+# gated by bench_compare — the drill's own exit code is the gate).
+# FLEET=0 skips (~1 min on the 1-core box); the full subprocess scaling
+# bench + kill -9 leg is `python tools/fleet_bench.py` and the committed
+# ARTIFACT_fleet_bench.json.
+if [ "${FLEET:-1}" != "0" ]; then
+    echo "== fleet drill =="
+    python tools/fleet_bench.py --quick
+    fleet_rc=$?
+    if [ "$fleet_rc" -ne 0 ]; then
+        echo "lint.sh: fleet drill FAILED (rc=$fleet_rc)" >&2
         rc=1
     fi
 fi

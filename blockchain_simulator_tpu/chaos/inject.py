@@ -36,7 +36,13 @@ Registered sites (grep ``chaos_point(`` for ground truth):
   carries ``replica`` and ``req_id``: a drill can slow or fail the path
   to ONE replica — the hedged-failover scenario);
 - ``fleet.handoff`` — serve/router.py, at the start of a dead replica's
-  WAL handoff (ctx carries ``replica``).
+  WAL handoff (ctx carries ``replica``);
+- ``sweep.chunk`` — parallel/sweep._run_chunk, once per chunk dispatch
+  ATTEMPT of a journaled sweep (ctx carries ``key``, ``index``, ``n``,
+  ``arm`` — ``primary``/``degrade``/``degrade-checkpoint`` — and
+  ``mesh``), so a drill can kill a sweep between durable chunk appends
+  (the resume drill) or wedge exactly the primary arm and watch the
+  supervisor degrade (parallel/journal.py).
 """
 
 from __future__ import annotations

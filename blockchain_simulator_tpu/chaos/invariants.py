@@ -192,6 +192,67 @@ def check_fleet(
     return violations
 
 
+def check_sweep_journal(
+    journal,
+    expected_keys=(),
+    expected_rows: int | None = None,
+) -> list[str]:
+    """The durable-sweep accounting contracts (parallel/journal.py):
+
+    1. **Each chunk key journaled at most once** — completed chunks are
+       skipped on resume, so a second valid line for one key means a
+       completed chunk was recomputed (the recompute-at-most-one rule
+       broken) or double-appended.
+    2. **Every journaled row checksums clean** — a chunk line whose rows
+       fail their checksums is bit rot or a torn write that PARSED; the
+       reader already demotes it to recompute, the checker reports it.
+    3. **Coverage** — every ``expected_keys`` chunk is present and valid,
+       and (``expected_rows``) the valid chunks carry that many rows
+       total.
+    4. **Events well-formed** — every supervisor event line names a known
+       transition, so the degrade trail is machine-readable.
+    """
+    from blockchain_simulator_tpu.parallel import journal as journal_mod
+
+    violations: list[str] = []
+    lines = journal.chunk_lines()
+    seen: dict[str, int] = {}
+    for rec in lines:
+        key = str(rec.get("key"))
+        seen[key] = seen.get(key, 0) + 1
+        rows, sums = rec.get("rows"), rec.get("sums")
+        if not isinstance(rows, list) or not isinstance(sums, list) \
+                or len(rows) != len(sums):
+            violations.append(f"chunk {key!r} line is malformed")
+            continue
+        bad = sum(1 for r, s in zip(rows, sums)
+                  if journal_mod.row_checksum(r) != s)
+        if bad:
+            violations.append(
+                f"chunk {key!r} has {bad}/{len(rows)} rows failing their "
+                f"checksum")
+    for key, n in seen.items():
+        if n > 1:
+            violations.append(
+                f"chunk {key!r} journaled {n} times (completed chunks "
+                f"must never recompute)")
+    done = journal.completed()
+    for key in expected_keys:
+        if str(key) not in done:
+            violations.append(f"expected chunk {key!r} missing/invalid")
+    if expected_rows is not None:
+        total = sum(len(rows) for rows in done.values())
+        if total != expected_rows:
+            violations.append(
+                f"journal carries {total} valid rows, expected "
+                f"{expected_rows}")
+    known = {"deadline", "probe", "retry", "degrade", "failed", "error"}
+    for ev in journal.events():
+        if ev.get("event") not in known:
+            violations.append(f"unknown supervisor event {ev.get('event')!r}")
+    return violations
+
+
 def check_server(
     ledger: Ledger | None,
     stats: dict,

@@ -31,7 +31,14 @@ Scenario catalog (tools/chaos_drill.py runs all; tests pick):
   ``dispatch-failed``, quarantined, resubmission never joins a batch;
 - ``crash-restart``   admitted requests outlive a dead server via the
   WAL: replayed exactly once per pending id, answers bit-equal (exact
-  sampler) to the uninterrupted reference, second restart replays zero.
+  sampler) to the uninterrupted reference, second restart replays zero;
+- ``sweep-kill9``     a journaled fault sweep dies mid-grid → rerunning
+  it resumes from the sweep journal (parallel/journal.py): completed
+  chunks never recompute, rows bit-equal to the uninterrupted sweep
+  (the subprocess SIGKILL variant is tools/sweep_resume_drill.py);
+- ``sweep-wedge``     a chunk's dispatch wedges → the supervisor's
+  deadline fires, bounded retries, then the recorded degrade arm
+  answers — the journal carries the whole transition trail.
 
 All scenarios run at toy scale (pbft n=8, exact sampler — the shared
 tests/test_zserve.py template) so the whole drill is compile-cheap and
@@ -446,6 +453,144 @@ def scenario_crash_restart(ctl, workdir, quick):
                       "replay_again": replay_again}}
 
 
+def _canon_rows(res) -> dict:
+    """``run_fault_sweep`` result -> {fault level: [canonical-JSON rows]}:
+    the bit-equality comparison for journaled sweeps.  Canonical JSON on
+    BOTH sides because resumed rows ride a JSON round trip (ints/floats
+    are repr-exact; container types normalize) — the honest equality for
+    rows that crossed a file."""
+    return {
+        fc.n_byzantine: [obs.canonical_json(m) for m in rows]
+        for fc, rows in res.items()
+    }
+
+
+def scenario_sweep_kill9(ctl, workdir, quick):
+    """The durable-sweep crash drill, in-process: a journaled fault sweep
+    dies (ChaosKill at the ``sweep.chunk`` point) with 2 of 4 level
+    chunks journaled; rerunning the SAME sweep on the same journal
+    resumes — completed chunks are never recomputed (their keys stay
+    unique in the journal, registry misses move 0), only the missing
+    levels dispatch, and every row is bit-equal to an un-journaled
+    reference sweep.  The subprocess SIGKILL variant (a REAL kill -9,
+    ARTIFACT_resume_sweep.json) lives in tools/sweep_resume_drill.py."""
+    from blockchain_simulator_tpu.parallel import journal as journal_mod
+    from blockchain_simulator_tpu.parallel.sweep import (
+        dyn_chunk_keys,
+        run_fault_sweep,
+    )
+    from blockchain_simulator_tpu.utils.config import FaultConfig, SimConfig
+
+    cfg = SimConfig(**TPL)
+    fcs = [FaultConfig(n_byzantine=f) for f in range(4)]
+    seeds = (0, 1)
+    jp = os.path.join(workdir, "sweep.journal")
+    kill_index = 2
+    ctl.fail_next("sweep.chunk", n=1, exc=inject.ChaosKill,
+                  match=lambda c: c.get("index") == kill_index)
+    violations = []
+    killed = False
+    try:
+        run_fault_sweep(cfg, fcs, seeds,
+                        journal=journal_mod.SweepJournal(jp))
+    except inject.ChaosKill:
+        killed = True
+    if not killed:
+        violations.append("chaos kill at chunk 2 never fired")
+    pre_keys = set(journal_mod.SweepJournal(jp).completed())
+    if len(pre_keys) != kill_index:
+        violations.append(
+            f"{len(pre_keys)} chunks survived the kill, want {kill_index}")
+    # resume: the same sweep call on the same journal path
+    misses_before = aotcache.registry.stats()["misses"]
+    resumed = run_fault_sweep(cfg, fcs, seeds,
+                              journal=journal_mod.SweepJournal(jp))
+    resume_misses = aotcache.registry.stats()["misses"] - misses_before
+    if resume_misses != 0:
+        violations.append(
+            f"resume compiled {resume_misses} executables (want 0: the "
+            f"sweep executable was warm)")
+    post = journal_mod.SweepJournal(jp)
+    post_keys = set(post.completed())
+    recomputed = [k for k in pre_keys if k not in post_keys]
+    if recomputed:
+        violations.append(f"completed chunks vanished on resume: "
+                          f"{sorted(recomputed)}")
+    appended = len(post_keys) - len(pre_keys)
+    if appended != len(fcs) - kill_index:
+        violations.append(
+            f"resume appended {appended} chunks, want "
+            f"{len(fcs) - kill_index} (recompute-at-most-one broken)")
+    reference = run_fault_sweep(cfg, fcs, seeds)
+    rows_equal = _canon_rows(resumed) == _canon_rows(reference)
+    if not rows_equal:
+        violations.append("resumed rows diverge from the uninterrupted "
+                          "reference sweep")
+    # coverage from the GRID, not the journal's own content: a journal
+    # that silently dropped a chunk must fail here
+    violations += invariants.check_sweep_journal(
+        post, expected_keys=dyn_chunk_keys(cfg, fcs, seeds),
+        expected_rows=len(fcs) * len(seeds),
+    )
+    return {"ledger": None, "stats": None, "violations": violations,
+            "extra": {"killed": killed,
+                      "chunks_before_kill": len(pre_keys),
+                      "chunks_resumed": appended,
+                      "resume_misses": resume_misses,
+                      "rows_bit_equal": rows_equal}}
+
+
+def scenario_sweep_wedge(ctl, workdir, quick):
+    """A chunk's primary dispatch wedges (chaos hang far beyond the
+    supervisor deadline, both attempts): the supervisor records
+    deadline → retry → deadline → degrade in the journal, the degrade
+    arm answers, later chunks dispatch normally, and the whole grid's
+    rows are bit-equal to an unsupervised reference — a hung chunk costs
+    bounded wall, never the sweep."""
+    from blockchain_simulator_tpu.parallel import journal as journal_mod
+    from blockchain_simulator_tpu.parallel.sweep import (
+        dyn_chunk_keys,
+        run_fault_sweep,
+    )
+    from blockchain_simulator_tpu.utils.config import FaultConfig, SimConfig
+
+    cfg = SimConfig(**TPL)
+    fcs = [FaultConfig(n_byzantine=f) for f in range(2)]
+    seeds = (0, 1)
+    jp = os.path.join(workdir, "sweep.journal")
+    # wedge chunk 0's primary arm only: the degrade arm (and chunk 1)
+    # must sail through — counted firings keep the schedule exact.  The
+    # hang must dwarf the deadline, the deadline must dwarf a warm n=8
+    # dispatch on the 1-core box (~0.2 s).
+    ctl.hang_next("sweep.chunk", seconds=2.0, n=2,
+                  match=lambda c: c.get("arm") == "primary"
+                  and c.get("index") == 0)
+    sup = journal_mod.ChunkSupervisor(deadline_s=1.0, retries=1,
+                                      backoff_s=0.02, rng=ctl.rng.random)
+    j = journal_mod.SweepJournal(jp)
+    result = run_fault_sweep(cfg, fcs, seeds, journal=j, supervise=sup)
+    # the two abandoned primary attempts are still sleeping/dispatching:
+    # drain them so neither this scenario's determinism twin nor process
+    # exit races a zombie mid-XLA
+    journal_mod.drain_abandoned()
+    events = [e["event"] for e in j.events()]
+    violations = []
+    want = ["deadline", "retry", "deadline", "degrade"]
+    if events != want:
+        violations.append(f"supervisor trail {events} != {want}")
+    reference = run_fault_sweep(cfg, fcs, seeds)
+    rows_equal = _canon_rows(result) == _canon_rows(reference)
+    if not rows_equal:
+        violations.append("degraded rows diverge from the reference sweep")
+    post = journal_mod.SweepJournal(jp)
+    violations += invariants.check_sweep_journal(
+        post, expected_keys=dyn_chunk_keys(cfg, fcs, seeds),
+        expected_rows=len(fcs) * len(seeds),
+    )
+    return {"ledger": None, "stats": None, "violations": violations,
+            "extra": {"events": events, "rows_bit_equal": rows_equal}}
+
+
 SCENARIOS = {
     "dispatch-fail": scenario_dispatch_fail,
     "dispatch-hang": scenario_dispatch_hang,
@@ -455,6 +600,8 @@ SCENARIOS = {
     "queue-storm": scenario_queue_storm,
     "poison-request": scenario_poison_request,
     "crash-restart": scenario_crash_restart,
+    "sweep-kill9": scenario_sweep_kill9,
+    "sweep-wedge": scenario_sweep_wedge,
 }
 
 

@@ -241,6 +241,17 @@ def pad_points(points, lanes: int):
     return points, n_real
 
 
+def align_chunk(chunk_size: int, lanes: int) -> int:
+    """Round a journal chunk size up to a multiple of the mesh's sweep
+    lanes (parallel/journal.py chunking): every chunk then pads at most
+    one partial tile through :func:`pad_points`, instead of every chunk
+    paying ``lanes - (size % lanes)`` discarded lanes."""
+    chunk_size = max(1, int(chunk_size))
+    lanes = max(1, int(lanes))
+    rem = chunk_size % lanes
+    return chunk_size + (lanes - rem if rem else 0)
+
+
 def mesh_shape_dict(mesh) -> dict:
     """``{axis name: size}`` of a mesh as plain JSON-able types — the one
     serialization every mesh-reporting surface shares (serve batch blocks,

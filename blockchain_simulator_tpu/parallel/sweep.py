@@ -25,6 +25,14 @@ commit tail by ±1 tick (measured once across a 22-point 10k sweep,
 models/pbft_round.py documents vs the tick engine; counts and milestones
 are unaffected.
 
+Durability: every dynamic-operand sweep accepts ``journal=`` (a
+parallel/journal.SweepJournal) — execution then chunks one fault level
+(seed tile) per fsynced journal append, a restarted identical sweep
+skips completed chunks (recompute <= the one in-flight chunk, rows
+bit-equal under the exact sampler), and ``supervise=`` adds per-chunk
+deadlines with bounded retry and a recorded degrade arm.  See
+parallel/journal.py for the journal-vs-WAL semantics.
+
 Compiled programs live in the unified executable registry
 (utils/aotcache.py) — hit/miss stats land on every run manifest.  The
 same-structure grouping below is pinned at the IR level by the graph
@@ -42,6 +50,7 @@ import jax.numpy as jnp
 
 from blockchain_simulator_tpu.chaos import inject
 from blockchain_simulator_tpu.models.base import canonical_fault_cfg, get_protocol
+from blockchain_simulator_tpu.parallel import journal as journal_mod
 from blockchain_simulator_tpu.parallel import partition
 from blockchain_simulator_tpu.parallel.mesh import NODES_AXIS, SWEEP_AXIS
 from blockchain_simulator_tpu.runner import (
@@ -184,33 +193,11 @@ def _dyn_operands(cfg: SimConfig, fc) -> tuple[int, int]:
     return fc.resolved_n_crashed(cfg.n), fc.n_byzantine
 
 
-def run_dyn_points(canon: SimConfig, points, record: bool = True,
-                   n_out: int | None = None, mesh=None):
-    """THE group-dispatch primitive: one vmapped executable over an
-    arbitrary list of same-structure ``(cfg, seed)`` points.
-
-    ``points`` is a sequence of ``(cfg, seed)`` pairs whose configs all
-    canonicalize to ``canon`` (``canonical_fault_cfg``) — they may differ
-    only in fault COUNTS, which become the traced per-lane operands.
-    Returns one metrics dict per point, in order, each bit-equal (exact
-    sampler; see the module caveat for the normal CLT path) to a solo run
-    of the same ``(cfg, seed)``.
-
-    Both the fault sweeps (:func:`run_fault_sweep`, a cross product of
-    points) and the scenario server's micro-batched dispatch
-    (serve/dispatch.py, whatever compatible requests are queued) route
-    through here.  ``record=False`` skips the per-row runs.jsonl hook for
-    callers that write their own access-log records (the server does);
-    ``n_out`` computes host-side metrics for only the first ``n_out``
-    points (the server's bucket-padded lanes are duplicates whose metrics
-    would be discarded).
-
-    With ``mesh`` set the batch axis shards over the mesh's sweep axis
-    through :func:`mesh_dyn_batched_fn` (parallel/partition.py): the point
-    list is padded to a multiple of the sweep axis size by repeating the
-    last point (padding lanes ride at the tail, so real-point indices are
-    unchanged and pad metrics are never computed).  A mesh of size 1 takes
-    the single-device path verbatim."""
+def _dispatch_dyn_points(canon: SimConfig, points, record: bool = True,
+                         n_out: int | None = None, mesh=None):
+    """ONE un-journaled vmapped dispatch of a same-structure point list —
+    the body :func:`run_dyn_points` either calls directly (no journal) or
+    wraps in chunked, supervised, durable execution."""
     points = list(points)
     # the batched-dispatch chaos point: the drills inject raise/hang/slow
     # here — the exact exception path a real backend fault takes through
@@ -243,19 +230,201 @@ def run_dyn_points(canon: SimConfig, points, record: bool = True,
     return out
 
 
-def _run_dyn_group(cfg: SimConfig, canon: SimConfig, fcs, seeds, mesh=None):
+def _run_chunk(canon, tile, record, n_out, mesh, supervise, journal, key,
+               index):
+    """Compute ONE chunk, optionally under the supervisor's deadline →
+    retry → degrade state machine (parallel/journal.py).  The
+    ``sweep.chunk`` chaos point fires once per ATTEMPT with the arm in
+    its ctx, so a drill can wedge exactly the primary arm and watch the
+    degrade arm answer."""
+
+    def primary():
+        inject.chaos_point("sweep.chunk", key=key, index=index,
+                           n=len(tile), arm="primary",
+                           mesh=mesh is not None)
+        return _dispatch_dyn_points(canon, tile, record, n_out, mesh)
+
+    if supervise is None:
+        return primary()
+
+    from blockchain_simulator_tpu.runner import use_round_schedule
+
+    if supervise.checkpoint_dir and len(tile) == 1 \
+            and not use_round_schedule(tile[0][0]):
+        # the very-long-single-sim arm: tick-level mid-chunk checkpoints
+        # (utils/checkpoint.py) — a re-kill resumes MID-chunk from the
+        # last segment instead of restarting the whole sim
+        cfg_pt, seed_pt = tile[0]
+
+        def degrade():
+            inject.chaos_point("sweep.chunk", key=key, index=index,
+                               n=len(tile), arm="degrade-checkpoint",
+                               mesh=False)
+            import os as _os
+
+            from blockchain_simulator_tpu import runner as runner_mod
+
+            m, _ = runner_mod.run_dyn_checkpointed(
+                cfg_pt, supervise.checkpoint_every_ms,
+                _os.path.join(supervise.checkpoint_dir, key), seed=seed_pt,
+            )
+            return [m]
+    else:
+        # the mesh-shrink arm (partition.py's size-1/no-mesh path): the
+        # single-device program is bit-equal under the exact sampler, so
+        # a degraded chunk's rows are indistinguishable from healthy ones
+        def degrade():
+            inject.chaos_point("sweep.chunk", key=key, index=index,
+                               n=len(tile), arm="degrade", mesh=False)
+            return _dispatch_dyn_points(canon, tile, record, n_out,
+                                        mesh=None)
+
+    rows, _events = journal_mod.run_supervised(
+        primary, degrade, supervise, journal=journal, key=key,
+    )
+    return rows
+
+
+def run_dyn_points(canon: SimConfig, points, record: bool = True,
+                   n_out: int | None = None, mesh=None, journal=None,
+                   chunk_size: int | None = None, supervise=None):
+    """THE group-dispatch primitive: one vmapped executable over an
+    arbitrary list of same-structure ``(cfg, seed)`` points.
+
+    ``points`` is a sequence of ``(cfg, seed)`` pairs whose configs all
+    canonicalize to ``canon`` (``canonical_fault_cfg``) — they may differ
+    only in fault COUNTS, which become the traced per-lane operands.
+    Returns one metrics dict per point, in order, each bit-equal (exact
+    sampler; see the module caveat for the normal CLT path) to a solo run
+    of the same ``(cfg, seed)``.
+
+    Both the fault sweeps (:func:`run_fault_sweep`, a cross product of
+    points) and the scenario server's micro-batched dispatch
+    (serve/dispatch.py, whatever compatible requests are queued) route
+    through here.  ``record=False`` skips the per-row runs.jsonl hook for
+    callers that write their own access-log records (the server does);
+    ``n_out`` computes host-side metrics for only the first ``n_out``
+    points (the server's bucket-padded lanes are duplicates whose metrics
+    would be discarded).
+
+    With ``mesh`` set the batch axis shards over the mesh's sweep axis
+    through :func:`mesh_dyn_batched_fn` (parallel/partition.py): the point
+    list is padded to a multiple of the sweep axis size by repeating the
+    last point (padding lanes ride at the tail, so real-point indices are
+    unchanged and pad metrics are never computed).  A mesh of size 1 takes
+    the single-device path verbatim.
+
+    **Durable execution** (``journal=``, a parallel/journal.SweepJournal):
+    the point list splits into ``chunk_size``-point chunks (default: one
+    chunk; the fault sweeps pass one chunk per fault level, aligned up to
+    the mesh lanes), each chunk's rows are appended to the journal —
+    fsynced, with per-row checksums and the registry ``cache`` block —
+    BEFORE the next chunk dispatches, and chunks whose content-addressed
+    key (parallel/journal.chunk_key) is already journaled are served from
+    the journal without dispatching: a restarted sweep recomputes at most
+    the one chunk that was in flight.  Resumed rows ride a JSON round
+    trip (ints/floats exact) and are NOT re-recorded to runs.jsonl.
+    ``supervise=`` (a journal.ChunkSupervisor) additionally wraps every
+    computed chunk in the deadline → retry/backoff → degrade machine,
+    with the transitions journaled as ``event`` lines — and works
+    without a journal too (chunked + supervised, just not durable).
+
+    The wedged-health fail-fast gate lives on the SWEEP entrypoints
+    (:func:`run_fault_sweep` / :func:`run_byzantine_sweep`), not here:
+    the scenario server's batched flushes route through this function
+    and its admission is already health-gated — raising per flush would
+    only be swallowed into an un-gated degrade-to-solo
+    (serve/dispatch.run_batch's typed-error wrapper)."""
+    points = list(points)
+    if journal is None and supervise is None:
+        return _dispatch_dyn_points(canon, points, record, n_out, mesh)
+    if not points:
+        return []
+    if chunk_size is None or n_out is not None:
+        # n_out callers (serve's bucket-padded flushes) journal the whole
+        # batch as ONE chunk: pad lanes never split across chunk keys
+        chunk_size = len(points)
+    if mesh is not None and partition.mesh_size(mesh) > 1:
+        chunk_size = partition.align_chunk(
+            chunk_size, max(partition.sweep_axis_size(mesh), 1)
+        )
+    done = journal.completed() if journal is not None else {}
+    out = []
+    for index, start in enumerate(range(0, len(points), chunk_size)):
+        tile = points[start:start + chunk_size]
+        want = len(tile) if n_out is None else max(0, min(len(tile), n_out))
+        t_out = None if n_out is None else want
+        key = journal_mod.chunk_key(canon, index, tile, mesh, n_out=t_out)
+        cached = done.get(key)
+        if cached is not None and len(cached) == want:
+            out.extend(cached)
+            continue
+        # every dispatch ATTEMPT runs record=False: only the winning
+        # arm's rows (journaled below) reach runs.jsonl — an abandoned
+        # slow attempt finishing late must not double-record its points
+        rows = _run_chunk(canon, tile, False, t_out, mesh, supervise,
+                          journal, key, index)
+        # durable BEFORE the next chunk dispatches — the recompute-at-
+        # most-one contract the kill -9 drill pins
+        if journal is not None:
+            journal.append_chunk(key, index, rows,
+                                 cache=aotcache.registry.manifest())
+        if record:
+            pts_out = tile if t_out is None else tile[:t_out]
+            for (cfg_i, seed_i), m in zip(pts_out, rows):
+                obs.record_run({"seed": int(seed_i), **m}, cfg_i)
+        out.extend(rows)
+    return out
+
+
+def dyn_chunk_keys(cfg: SimConfig, fault_configs, seeds, mesh=None):
+    """The chunk keys a journaled ``run_fault_sweep(cfg, fault_configs,
+    seeds, mesh=..., journal=...)`` will use for ONE same-structure group
+    — derived from the grid alone, never from a journal's content, so a
+    drill's coverage check is independent evidence (a journal that
+    silently lost a chunk fails it).  All ``fault_configs`` must share
+    one canonical structure (the helper asserts it)."""
+    fcs = list(fault_configs)
+    canons = {canonical_fault_cfg(cfg.with_(faults=fc)) for fc in fcs}
+    if len(canons) != 1:
+        raise ValueError(
+            f"dyn_chunk_keys covers one structure group, got {len(canons)}"
+        )
+    canon = next(iter(canons))
+    chunk = len(seeds)
+    if mesh is not None and partition.mesh_size(mesh) > 1:
+        chunk = partition.align_chunk(
+            chunk, max(partition.sweep_axis_size(mesh), 1)
+        )
+    points = [(cfg.with_(faults=fc), s) for fc in fcs for s in seeds]
+    return [
+        journal_mod.chunk_key(canon, i, points[st:st + chunk], mesh)
+        for i, st in enumerate(range(0, len(points), chunk))
+    ]
+
+
+def _run_dyn_group(cfg: SimConfig, canon: SimConfig, fcs, seeds, mesh=None,
+                   journal=None, supervise=None):
     """One compiled program for every (fault config, seed) point of a
     same-structure group; returns {fc: [metrics per seed]} with rows
-    bit-equal to ``run_seed_sweep(cfg.with_(faults=fc), seeds)``."""
+    bit-equal to ``run_seed_sweep(cfg.with_(faults=fc), seeds)``.
+
+    With a journal, the group chunks one-fault-level-per-chunk (the
+    seed tile) — the ISSUE's canonical-structure-group × level tile —
+    so a crash mid-grid loses at most one level's seed batch."""
     points = [(cfg.with_(faults=fc), seed) for fc in fcs for seed in seeds]
-    rows = run_dyn_points(canon, points, mesh=mesh)
+    tiled = journal is not None or supervise is not None
+    rows = run_dyn_points(canon, points, mesh=mesh, journal=journal,
+                          chunk_size=len(seeds) if tiled else None,
+                          supervise=supervise)
     n_s = len(seeds)
     return {
         fc: rows[i * n_s:(i + 1) * n_s] for i, fc in enumerate(fcs)
     }
 
 
-def run_fault_sweep(cfg: SimConfig, fault_configs, seeds, mesh=None):
+def run_fault_sweep(cfg: SimConfig, fault_configs, seeds, mesh=None,
+                    journal=None, supervise=None):
     """BASELINE config 4: sweep fault configs with seeds vmapped inside.
     Returns {fault_config: [metrics per seed]}.
 
@@ -274,7 +443,23 @@ def run_fault_sweep(cfg: SimConfig, fault_configs, seeds, mesh=None):
     batch over the mesh's sweep axis (see :func:`run_dyn_points`); the
     static fallback stays single-device — its mesh story is
     ``run_seed_sweep(mesh=...)``'s node-sharded one, with different
-    divisibility requirements."""
+    divisibility requirements.
+
+    ``journal=`` (parallel/journal.SweepJournal) makes the sweep durable:
+    each structure group chunks one fault level (seed tile) per journaled
+    chunk, and a restarted identical sweep skips completed chunks —
+    recompute is at most the one in-flight chunk, rows bit-equal under
+    the exact sampler.  The static (un-batchable) fallback is NOT
+    journaled — it has no dynamic-operand chunk identity.  ``supervise=``
+    (journal.ChunkSupervisor) adds per-chunk deadlines with bounded
+    retry and a recorded degrade arm.  Before any dispatch, a fresh
+    ``wedged`` verdict in the rolling health log
+    ($BLOCKSIM_HEALTH_JSONL) fails fast with the typed
+    ``utils.health.BackendWedgedError`` instead of hanging on backend
+    init — the bench.py ladder rule, now on the sweep tier."""
+    from blockchain_simulator_tpu.utils import health
+
+    health.require_not_wedged()
     fault_configs = list(fault_configs)
     groups: dict[SimConfig, list] = {}
     order = {}
@@ -290,7 +475,8 @@ def run_fault_sweep(cfg: SimConfig, fault_configs, seeds, mesh=None):
         order[fc] = canon
     done: dict = {}
     for canon, fcs in groups.items():
-        done.update(_run_dyn_group(cfg, canon, fcs, seeds, mesh=mesh))
+        done.update(_run_dyn_group(cfg, canon, fcs, seeds, mesh=mesh,
+                                   journal=journal, supervise=supervise))
     results = {}
     for fc in fault_configs:
         if order[fc] is None:
@@ -301,7 +487,7 @@ def run_fault_sweep(cfg: SimConfig, fault_configs, seeds, mesh=None):
 
 
 def run_byzantine_sweep(cfg: SimConfig, f_values=None, seeds=(0,), forge=True,
-                        mesh=None):
+                        mesh=None, journal=None, supervise=None):
     """BASELINE config 4 end-to-end: sweep the Byzantine count f over
     ``f_values`` (default 0..(n-1)//3), seeds batched per f — the whole
     sweep is ONE vmapped executable over (f, seed) (dynamic fault operands;
@@ -329,7 +515,8 @@ def run_byzantine_sweep(cfg: SimConfig, f_values=None, seeds=(0,), forge=True,
         for f in f_values
     ]
     # dedup: repeated f values share one fault config (and one batch row set)
-    res = run_fault_sweep(cfg, list(dict.fromkeys(fcs)), seeds, mesh=mesh)
+    res = run_fault_sweep(cfg, list(dict.fromkeys(fcs)), seeds, mesh=mesh,
+                          journal=journal, supervise=supervise)
     out = []
     for f, fc in zip(f_values, fcs):
         for seed, m in zip(seeds, res[fc]):

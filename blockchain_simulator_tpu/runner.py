@@ -402,6 +402,133 @@ def run_checkpointed(
     return proto.metrics(cfg, state), last_path
 
 
+def _dyn_checkpoint_cfg(cfg: SimConfig, seed: int | None) -> SimConfig:
+    """Validate + normalize a config for dynamic-fault checkpointed
+    execution: tick schedule pinned (the fast paths are not
+    tick-segmentable — same rule as :func:`run_checkpointed`), effective
+    seed baked in, batchability and cpp-only modes checked up front."""
+    check_batchable(cfg)
+    _reject_cpp_only(cfg)
+    if use_round_schedule(cfg):
+        if cfg.schedule == "round":
+            raise ValueError(
+                "schedule='round' does not support checkpointing (the round "
+                "fast path is not tick-segmentable); use schedule='tick'"
+            )
+        cfg = cfg.with_(schedule="tick")
+    if seed is not None:
+        cfg = cfg.with_(seed=seed)
+    return cfg
+
+
+def run_dyn_checkpointed(
+    cfg: SimConfig,
+    every_ms: int,
+    ckpt_dir,
+    seed: int | None = None,
+    keep_all: bool = False,
+    resume: bool = True,
+):
+    """The dynamic-fault-operand analog of :func:`run_checkpointed` — and
+    the sweep supervisor's tick-level degrade arm for very long
+    single-sim chunks (parallel/journal.py): init at the CANONICAL fault
+    structure, install the traced fault masks from ``cfg.faults``' counts
+    (models/base.dyn_fault_masks — the masks then ride ``state`` as
+    ordinary leaves, so the shared ``segment`` executable advances them),
+    and checkpoint every ``every_ms`` virtual ms with the ``(n_crashed,
+    n_byzantine)`` operands stored alongside state/bufs.
+
+    ``resume=True`` (default): when ``ckpt_dir`` already holds a
+    ``ckpt_*.npz`` from a crashed run of the SAME config, execution
+    continues from the latest one instead of restarting — a re-killed
+    chunk loses at most one segment.  A checkpoint for a different
+    config (or a static-path archive with no ``__dyn__`` entry) raises
+    rather than silently blending two runs.
+
+    Rows are bit-equal to the un-checkpointed dyn program
+    (``jit(make_dyn_sim_fn(cfg))``) — the tick keys derive from absolute
+    ticks (utils/prng.py), pinned in tests/test_checkpoint.py.
+    Returns ``(metrics, last_checkpoint_path)``."""
+    import pathlib
+
+    from blockchain_simulator_tpu.utils.checkpoint import (
+        load_checkpoint,
+        load_dyn_counts,
+        save_checkpoint,
+    )
+
+    if every_ms < 1:
+        raise ValueError(f"every_ms must be >= 1, got {every_ms}")
+    cfg = _dyn_checkpoint_cfg(cfg, seed)
+    canon = base_model.canonical_fault_cfg(cfg)
+    nc = cfg.faults.resolved_n_crashed(cfg.n)
+    nb = cfg.faults.n_byzantine
+    proto = get_protocol(cfg.protocol)
+    key = jax.random.key(cfg.seed)
+    ckpt_dir = pathlib.Path(ckpt_dir)
+    ckpt_dir.mkdir(parents=True, exist_ok=True)
+    existing = sorted(ckpt_dir.glob("ckpt_*.npz")) if resume else []
+    if existing:
+        stored_cfg, state, bufs, t = load_checkpoint(existing[-1])
+        if stored_cfg != cfg:
+            raise ValueError(
+                f"checkpoint {existing[-1]} belongs to a different config "
+                f"(stored hash != requested); refusing to blend runs"
+            )
+        stored_dyn = load_dyn_counts(existing[-1])
+        if stored_dyn != (nc, nb):
+            raise ValueError(
+                f"checkpoint {existing[-1]} stores dyn operands "
+                f"{stored_dyn}, requested ({nc}, {nb})"
+            )
+        last_path = existing[-1]
+    else:
+        state, bufs = proto.init(canon, jax.random.fold_in(key, 0x1217))
+        state = base_model.apply_fault_masks(
+            cfg, state, *base_model.dyn_fault_masks(cfg.n, nc, nb)
+        )
+        t, last_path = 0, None
+    while t < cfg.ticks:
+        n = min(every_ms, cfg.ticks - t)
+        state, bufs = make_segment_fn(canon, n)(key, state, bufs, jnp.int32(t))
+        t += n
+        jax.block_until_ready(state)
+        path = ckpt_dir / f"ckpt_{t:08d}.npz"
+        save_checkpoint(path, cfg, state, bufs, t, dyn_counts=(nc, nb))
+        if last_path is not None and not keep_all:
+            last_path.unlink()
+        last_path = path
+    return proto.metrics(cfg, state), last_path
+
+
+def resume_dyn_simulation(ckpt_path):
+    """Load a dynamic-fault checkpoint and run the remaining ticks through
+    the canonical-structure ``segment`` executable; returns metrics
+    bit-equal to the uninterrupted dyn run.  Raises on a static-path
+    archive (no stored operands) — use :func:`resume_simulation`."""
+    from blockchain_simulator_tpu.utils.checkpoint import (
+        load_checkpoint,
+        load_dyn_counts,
+    )
+
+    dyn = load_dyn_counts(ckpt_path)
+    if dyn is None:
+        raise ValueError(
+            f"{ckpt_path} is a static-path checkpoint (no __dyn__ operands);"
+            " use resume_simulation"
+        )
+    cfg, state, bufs, t = load_checkpoint(ckpt_path)
+    canon = base_model.canonical_fault_cfg(cfg)
+    proto = get_protocol(cfg.protocol)
+    key = jax.random.key(cfg.seed)
+    if t < cfg.ticks:
+        state, bufs = make_segment_fn(canon, cfg.ticks - t)(
+            key, state, bufs, jnp.int32(t)
+        )
+        jax.block_until_ready(state)
+    return proto.metrics(cfg, state)
+
+
 def resume_simulation(ckpt_path, seed: int | None = None):
     """Load a checkpoint and run the remaining ticks; returns metrics.
 

@@ -303,6 +303,13 @@ def main(argv=None) -> int:
                         "requests (serve/wal.py): a restarted daemon "
                         "replays admitted-but-unanswered requests exactly "
                         "once per pending id")
+    p.add_argument("--journal", default=None, metavar="PATH",
+                   help="durable-sweep journal (parallel/journal.py) for "
+                        "batched flushes: a restarted daemon answers a "
+                        "WAL-replayed batch whose rows were already "
+                        "computed from the journal instead of re-running "
+                        "it — long sweep-shaped request batches ride the "
+                        "same chunk journal as run_fault_sweep")
     p.add_argument("--wal-no-sync", action="store_true",
                    help="skip the per-admit fsync (faster admission, "
                         "admits may be lost to an OS crash — process "
@@ -390,6 +397,7 @@ def main(argv=None) -> int:
         breaker_cooldown_s=args.breaker_cooldown_s,
         mesh=mesh,
         replica=args.replica_id,
+        journal_path=args.journal,
     )
     if args.prewarm:
         try:

@@ -89,7 +89,8 @@ def _solo_metrics(req):
 
 
 def run_batch(reqs, max_batch: int, force_solo: bool = False,
-              solo_reason: str | None = None, mesh=None) -> list[tuple]:
+              solo_reason: str | None = None, mesh=None,
+              journal=None) -> list[tuple]:
     """Dispatch one same-group batch; returns ``[(req, response)]`` in
     order, one entry per request, every response either 200 or a typed
     error body.
@@ -111,7 +112,17 @@ def run_batch(reqs, max_batch: int, force_solo: bool = False,
     circuit breaker, when a group's vmapped path is known-bad);
     ``solo_reason`` labels the batch ``mode`` of such intentional solo
     dispatches (``breaker-solo``, ``quarantined-solo``) so the access log
-    distinguishes policy from degradation."""
+    distinguishes policy from degradation.
+
+    ``journal`` (a parallel/journal.SweepJournal — ``ScenarioServer(
+    journal_path=)``, daemon ``--journal``): batched flushes ride the
+    durable-sweep journal as single-chunk dispatches keyed on their
+    content (canonical structure + the padded point list), so a long
+    sweep-shaped request batch survives a daemon death — the WAL replays
+    the *admissions*, and the journal answers any batch whose rows were
+    already computed without recompiling or re-running it.  Solo and
+    degrade dispatches stay un-journaled (their recompute is one
+    request)."""
     t0 = time.monotonic()
     canon = reqs[0].canon
     group = obs.config_hash(canon)
@@ -163,7 +174,7 @@ def run_batch(reqs, max_batch: int, force_solo: bool = False,
         # request access-log records; n_out skips pad-lane metrics
         rows = sweep.run_dyn_points(
             canon, [(r.cfg, r.seed) for r in lanes], record=False,
-            n_out=len(reqs), mesh=mesh,
+            n_out=len(reqs), mesh=mesh, journal=journal,
         )
         out = []
         for req, m in zip(reqs, rows):

@@ -183,6 +183,7 @@ class ScenarioServer:
         restart_backoff_s: float = 0.05,
         mesh=None,
         replica: str | None = None,
+        journal_path: str | None = None,
     ):
         if max_batch < 1 or max_queue < 1:
             raise ValueError("max_batch and max_queue must be >= 1")
@@ -190,6 +191,15 @@ class ScenarioServer:
         # mesh-partitioned sweep executable (serve/dispatch.py mesh arg;
         # parallel/partition.py) — the daemon's --mesh-sweep knob
         self.mesh = mesh
+        # durable-sweep journal (parallel/journal.py; daemon --journal):
+        # batched flushes append their rows content-keyed, so a WAL replay
+        # of an already-computed batch is answered from the journal
+        # instead of re-executed (serve/dispatch.run_batch journal=)
+        self._journal = None
+        if journal_path:
+            from blockchain_simulator_tpu.parallel.journal import SweepJournal
+
+            self._journal = SweepJournal(journal_path)
         self.max_batch = int(max_batch)
         self.max_wait_ms = float(max_wait_ms)
         self.max_queue = int(max_queue)
@@ -633,6 +643,7 @@ class ScenarioServer:
         results = dispatch.run_batch(
             reqs, self.max_batch,
             force_solo=force_solo, solo_reason=solo_reason, mesh=self.mesh,
+            journal=self._journal,
         )
         degraded = any(
             resp.get("batch", {}).get("degraded") for _, resp in results
@@ -692,6 +703,8 @@ class ScenarioServer:
                     "default_timeout_s": self.default_timeout_s,
                     "breaker_threshold": self.breaker_threshold,
                     "breaker_cooldown_s": self.breaker_cooldown_s,
+                    "journal": (self._journal.path
+                                if self._journal is not None else None),
                 },
                 # the batched-dispatch mesh (None = single-device): axis
                 # name -> size, matching the registry snapshot's per-entry

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import pathlib
 
 import jax
@@ -32,18 +33,46 @@ def config_from_json(s: str) -> SimConfig:
     return SimConfig(**d)
 
 
-def save_checkpoint(path, cfg: SimConfig, state, bufs, tick: int) -> None:
-    """Write one checkpoint: config + tick + all state/buffer leaves."""
+def save_checkpoint(path, cfg: SimConfig, state, bufs, tick: int,
+                    dyn_counts=None) -> None:
+    """Write one checkpoint: config + tick + all state/buffer leaves.
+
+    ``dyn_counts`` — the traced ``(n_crashed, n_byzantine)`` fault
+    operands of a dynamic-fault-operand run (runner.run_dyn_checkpointed):
+    stored alongside state/bufs so a resumed run re-derives the exact
+    masks (models/base.dyn_fault_masks) the crashed run was tracing.
+    ``None`` (the static path) writes no ``__dyn__`` entry — archives
+    stay readable both ways."""
     arrays = {}
     for prefix, tree in (("s", state), ("b", bufs)):
         for i, leaf in enumerate(jax.tree.leaves(tree)):
             arrays[f"{prefix}{i}"] = np.asarray(leaf)
-    np.savez(
-        path,
-        __cfg__=np.frombuffer(config_to_json(cfg).encode(), dtype=np.uint8),
-        __tick__=np.int64(tick),
-        **arrays,
-    )
+    if dyn_counts is not None:
+        nc, nb = dyn_counts
+        arrays["__dyn__"] = np.asarray([int(nc), int(nb)], dtype=np.int32)
+    # content-first atomicity (the WAL/journal rule): write the archive to
+    # a sibling tmp, fsync, then os.replace — a kill mid-save can never
+    # leave a torn ckpt_*.npz for the resume glob to trip over (the tmp
+    # name does not match the glob).  This is load-bearing for the sweep
+    # supervisor's re-kill story (runner.run_dyn_checkpointed resume=True
+    # trusts the newest archive).
+    path = str(path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(
+                f,
+                __cfg__=np.frombuffer(config_to_json(cfg).encode(),
+                                      dtype=np.uint8),
+                __tick__=np.int64(tick),
+                **arrays,
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def load_checkpoint(path):
@@ -71,3 +100,14 @@ def load_checkpoint(path):
         [jax.numpy.asarray(z[f"b{i}"]) for i in range(len(jax.tree.leaves(b0)))],
     )
     return cfg, state, bufs, tick
+
+
+def load_dyn_counts(path):
+    """The stored ``(n_crashed, n_byzantine)`` dynamic-fault operands of a
+    checkpoint, or ``None`` for a static-path archive (pre-dyn
+    checkpoints have no ``__dyn__`` entry — tolerated, not an error)."""
+    z = np.load(pathlib.Path(path))
+    if "__dyn__" not in z:
+        return None
+    d = np.asarray(z["__dyn__"])
+    return int(d[0]), int(d[1])

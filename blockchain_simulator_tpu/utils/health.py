@@ -42,6 +42,51 @@ VERDICTS = ("healthy", "sick", "wedged")
 HEALTH_ENV = "BLOCKSIM_HEALTH_JSONL"
 
 
+class BackendWedgedError(RuntimeError):
+    """The rolling health log's latest verdict says the backend is wedged
+    (KNOWN_ISSUES.md #3): dispatching would hang on backend init, so the
+    caller fails fast instead.  Typed so the sweep tier
+    (parallel/sweep.py ``journal=`` paths and the sweep entrypoints) and
+    drills classify the refusal without string-matching.  Carries the
+    offending verdict record as ``.verdict``."""
+
+    def __init__(self, verdict: dict):
+        self.verdict = dict(verdict)
+        super().__init__(
+            f"backend wedged per health log (probe_s="
+            f"{verdict.get('probe_s')}, ts={verdict.get('ts')}): refusing "
+            "to dispatch — a wedged tunnel turns backend init into a "
+            "~25-minute hang (KNOWN_ISSUES.md #3); re-probe with "
+            "`python -m blockchain_simulator_tpu.utils.health`"
+        )
+
+
+def require_not_wedged(path: str | None = None, max_age_s: float = 3600.0,
+                       replica: str | None = None) -> dict | None:
+    """Fail fast on a fresh ``wedged`` verdict — the sweep tier's
+    admission gate (the way bench.py ladders its measurements behind the
+    probe): consulted before dispatch so a multi-hour grid never hangs on
+    backend init a probe already classified.
+
+    Reads :func:`latest_verdict` (explicit path, else
+    ``$BLOCKSIM_HEALTH_JSONL``; no log = no gate) and raises the typed
+    :class:`BackendWedgedError` only when the latest verdict is
+    ``wedged`` AND younger than ``max_age_s`` (a stale verdict from hours
+    ago says nothing about the tunnel now — bench.py re-probes, sweeps
+    fail open).  Returns the verdict record consulted (or None), so
+    callers can journal the provenance."""
+    rec = latest_verdict(path, replica=replica)
+    if rec is None:
+        return None
+    if rec.get("verdict") == "wedged":
+        ts = rec.get("ts")
+        fresh = not (isinstance(ts, (int, float))
+                     and time.time() - ts > max_age_s)
+        if fresh:
+            raise BackendWedgedError(rec)
+    return rec
+
+
 def probe_backend(platform: str | None = None,
                   replica: str | None = None) -> dict:
     """Probe whatever backend jax resolves (or ``platform``) in-process.

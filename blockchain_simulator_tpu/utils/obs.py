@@ -113,6 +113,15 @@ def manifest(cfg=None, backend=None, device_count=None) -> dict:
     return rec
 
 
+def canonical_json(rec) -> str:
+    """THE canonical JSON encoding shared by every content-addressed
+    surface (sweep-journal chunk keys and row checksums,
+    parallel/journal.py): sorted keys, compact separators, no default
+    coercion — a value json can't encode should fail loudly here, not
+    checksum differently on the read side after a round trip."""
+    return json.dumps(rec, sort_keys=True, separators=(",", ":"))
+
+
 def percentile(xs, q: float) -> float:
     """Nearest-rank percentile on a sorted copy — THE percentile every
     latency surface shares (serve self-test, tools/serve_bench.py), so the

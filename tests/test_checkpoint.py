@@ -10,13 +10,16 @@ from blockchain_simulator_tpu import SimConfig, run_simulation
 from blockchain_simulator_tpu.runner import (
     final_state,
     make_segment_fn,
+    resume_dyn_simulation,
     resume_simulation,
     run_checkpointed,
+    run_dyn_checkpointed,
 )
 from blockchain_simulator_tpu.utils.checkpoint import (
     config_from_json,
     config_to_json,
     load_checkpoint,
+    load_dyn_counts,
     save_checkpoint,
 )
 from blockchain_simulator_tpu.utils.config import FaultConfig
@@ -111,6 +114,68 @@ def test_checkpoint_other_protocols(tmp_path):
         m_full = run_simulation(cfg)
         m_seg, _ = run_checkpointed(cfg, every_ms=250, ckpt_dir=tmp_path / proto_name)
         assert m_seg == m_full
+
+
+DYN_CFG = CFG.with_(sim_ms=600, faults=FaultConfig(n_byzantine=2))
+
+
+def _dyn_reference(cfg, seed):
+    """The un-checkpointed dynamic-fault-operand run: the bit-equality
+    anchor for the dyn checkpoint path (same program family the sweeps
+    and the serving tier dispatch)."""
+    from blockchain_simulator_tpu.models.base import canonical_fault_cfg
+    from blockchain_simulator_tpu.parallel.sweep import run_dyn_points
+
+    return run_dyn_points(canonical_fault_cfg(cfg), [(cfg, seed)])[0]
+
+
+# every_ms=200 throughout: every dyn test then shares ONE canonical
+# 200-tick segment executable (make_segment_fn is keyed on (cfg, n)), so
+# the three tests below cost two compiles total — this file runs inside
+# the tier-1 870 s window, compile frugality is the budget
+
+
+def test_dyn_checkpointed_matches_dyn_program(tmp_path):
+    # the traced-operand path, segmented with checkpoints every 200 ms,
+    # is bit-equal to the one-shot dyn program; the archive stores the
+    # (n_crashed, n_byzantine) operands alongside state/bufs
+    ref = _dyn_reference(DYN_CFG, 5)
+    m, last = run_dyn_checkpointed(DYN_CFG, every_ms=200,
+                                   ckpt_dir=tmp_path, seed=5)
+    assert m == ref
+    assert load_dyn_counts(last) == (0, 2)
+
+
+def test_dyn_resume_mid_run_bit_equal(tmp_path):
+    # resume from a MID-run snapshot reproduces the uninterrupted run
+    ref = _dyn_reference(DYN_CFG, 5)
+    _, _ = run_dyn_checkpointed(DYN_CFG, every_ms=200, ckpt_dir=tmp_path,
+                                seed=5, keep_all=True)
+    mids = sorted(tmp_path.glob("ckpt_*.npz"))
+    assert len(mids) == 3
+    assert resume_dyn_simulation(mids[1]) == ref
+    # crash-resume: run_dyn_checkpointed on a dir holding only the first
+    # snapshot continues from it (the supervisor's re-kill story)
+    for p in mids[1:]:
+        p.unlink()
+    m2, _ = run_dyn_checkpointed(DYN_CFG, every_ms=200, ckpt_dir=tmp_path,
+                                 seed=5)
+    assert m2 == ref
+
+
+def test_dyn_checkpoint_guards(tmp_path):
+    # a static archive refuses resume_dyn_simulation (and vice versa the
+    # dyn dir refuses a mismatched config); every_ms values reuse the
+    # segment sizes earlier tests in this file already compiled
+    _, last = run_checkpointed(CFG, every_ms=300, ckpt_dir=tmp_path / "s")
+    assert load_dyn_counts(last) is None
+    with pytest.raises(ValueError, match="static-path"):
+        resume_dyn_simulation(last)
+    run_dyn_checkpointed(DYN_CFG, every_ms=200, ckpt_dir=tmp_path / "d",
+                         seed=5)
+    with pytest.raises(ValueError, match="different config"):
+        run_dyn_checkpointed(DYN_CFG.with_(sim_ms=900), every_ms=200,
+                             ckpt_dir=tmp_path / "d", seed=5)
 
 
 def test_checkpoint_queued_links(tmp_path):

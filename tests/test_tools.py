@@ -223,6 +223,27 @@ def test_bench_compare_never_gates_fleet_counters(tmp_path):
     assert "fleet_rps" in proc.stdout
 
 
+def test_bench_compare_never_gates_journal_resume_series(tmp_path):
+    """The durable-sweep series (journal_ from mesh_sweep_bench --journal,
+    resume_ from tools/sweep_resume_drill.py) are charted only: overhead
+    pct and recompute counts are lower-is-better with their own
+    drill/bench exit codes — a drop (a fix, or a fuller journal) must
+    never trip the throughput rule."""
+    runs = tmp_path / "runs.jsonl"
+    rows = []
+    for metric, vals in (("journal_overhead_pct", (2.8, 0.4)),
+                         ("resume_recomputed_chunks", (1, 0)),
+                         ("resume_points_per_s", (5000.0, 100.0))):
+        rows += [{"metric": metric, "value": v,
+                  "manifest": {"obs_schema": 1}} for v in vals]
+    runs.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    proc = _run([str(BENCH_COMPARE), _bench_artifact(tmp_path, 1, 100.0),
+                 "--runs", str(runs)])
+    assert proc.returncode == 0, proc.stdout
+    assert "journal_overhead_pct" in proc.stdout
+    assert "resume_recomputed_chunks" in proc.stdout
+
+
 def test_bench_compare_gates_p99_latency_inverted(tmp_path):
     """serve_p99_ms is lower-is-better AND gated: an increase beyond the
     threshold is the regression; a decrease (faster serving) never trips."""
@@ -312,9 +333,12 @@ def test_lint_sh_chains_both_gates(tmp_path):
         # executables — covered by tests/test_zzpartition.py.
         # FLEET=0: the fleet drill runs every fleet scenario twice —
         # covered by tests/test_zfleet.py (scenario-level + slow CLI).
+        # RESUME=0: the sweep resume drill SIGKILLs a real subprocess
+        # pair — covered by tests/test_zjournal.py (in-process resume
+        # pin) and the slow CLI test.
         env={**os.environ, "BLOCKSIM_RUNS_JSONL": str(runs),
              "WARM_BENCH": "0", "GRAPH": "0", "SERVE": "0", "CHAOS": "0",
-             "MESH_SWEEP": "0", "FLEET": "0"},
+             "MESH_SWEEP": "0", "FLEET": "0", "RESUME": "0"},
     )
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
     assert "jaxlint" in proc.stdout and "no regression" in proc.stdout
@@ -331,6 +355,8 @@ def test_lint_sh_chains_both_gates(tmp_path):
     assert '"${MESH_SWEEP:-1}"' in script
     assert "tools/fleet_bench.py --quick" in script
     assert '"${FLEET:-1}"' in script
+    assert "tools/sweep_resume_drill.py --quick" in script
+    assert '"${RESUME:-1}"' in script
     recs = [json.loads(ln) for ln in runs.read_text().strip().splitlines()]
     lint_recs = [r for r in recs if r.get("metric") == "jaxlint_new_findings"]
     assert lint_recs and lint_recs[-1]["value"] == 0

@@ -317,6 +317,23 @@ def test_scenario_crash_restart_replays_bit_equal():
     assert rep["replay_again"] == 0  # second restart: exactly-once held
 
 
+def test_scenario_sweep_kill9_resumes_without_recompute():
+    rep = _run_clean("sweep-kill9")
+    assert rep["killed"] is True
+    assert rep["chunks_before_kill"] == 2
+    assert rep["chunks_resumed"] == 2
+    assert rep["resume_misses"] == 0
+    assert rep["rows_bit_equal"] is True
+    assert rep["chaos_schedule"] == ["sweep.chunk:fail"]
+
+
+def test_scenario_sweep_wedge_takes_degrade_path():
+    rep = _run_clean("sweep-wedge")
+    assert rep["events"] == ["deadline", "retry", "deadline", "degrade"]
+    assert rep["rows_bit_equal"] is True
+    assert rep["chaos_schedule"] == ["sweep.chunk:hang"] * 2
+
+
 def test_scenario_determinism_same_seed_twice():
     """The drill's core claim at test scale: one chaos seed, two runs,
     byte-equal normalized summaries."""

@@ -1,0 +1,419 @@
+"""Durable-sweep journal tests (parallel/journal.py + the sweep layer's
+journal=/supervise= paths + the health fail-fast gate + serve wiring).
+
+Late-alphabet file on purpose: the end-to-end tests compile the shared
+n=8 dynamic-fault executable, so the earlier suites' registry warms it
+first under the tier-1 window (the test_zsweep_cache convention)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from blockchain_simulator_tpu.chaos import inject, invariants
+from blockchain_simulator_tpu.models.base import canonical_fault_cfg
+from blockchain_simulator_tpu.parallel import journal as journal_mod
+from blockchain_simulator_tpu.parallel import partition
+from blockchain_simulator_tpu.parallel.journal import (
+    ChunkFailedError,
+    ChunkSupervisor,
+    SweepJournal,
+    chunk_key,
+    row_checksum,
+    run_supervised,
+)
+from blockchain_simulator_tpu.parallel.sweep import (
+    run_dyn_points,
+    run_fault_sweep,
+)
+from blockchain_simulator_tpu.utils import aotcache, health, obs
+from blockchain_simulator_tpu.utils.config import FaultConfig, SimConfig
+
+CFG = SimConfig(protocol="pbft", n=8, sim_ms=200, stat_sampler="exact")
+CANON = canonical_fault_cfg(CFG)
+
+
+def _points(n_levels=3, seeds=(0, 1)):
+    return [(CFG.with_(faults=FaultConfig(n_byzantine=f)), s)
+            for f in range(n_levels) for s in seeds]
+
+
+def _cjson(rows):
+    return [obs.canonical_json(r) for r in rows]
+
+
+# ------------------------------------------------------------ chunk keys ---
+
+
+def test_chunk_key_depends_on_identity_not_order_of_calls():
+    pts = _points(2)
+    k = chunk_key(CANON, 0, pts[:2])
+    assert k == chunk_key(CANON, 0, pts[:2])
+    assert k != chunk_key(CANON, 1, pts[:2])          # index
+    assert k != chunk_key(CANON, 0, pts[2:4])         # points
+    assert k != chunk_key(CFG.with_(n=16), 0, pts[:2])  # canon
+
+
+def test_chunk_key_stable_across_processes(tmp_path):
+    """The resume contract's foundation: a different process computes the
+    SAME key for the same (canon, index, points) — no id()s, no dict
+    order, no per-process salt."""
+    pts = _points(1)
+    local = chunk_key(CANON, 3, pts)
+    prog = (
+        "from blockchain_simulator_tpu.parallel.journal import chunk_key\n"
+        "from blockchain_simulator_tpu.models.base import canonical_fault_cfg\n"
+        "from blockchain_simulator_tpu.utils.config import FaultConfig, SimConfig\n"
+        "cfg = SimConfig(protocol='pbft', n=8, sim_ms=200, stat_sampler='exact')\n"
+        "canon = canonical_fault_cfg(cfg)\n"
+        "pts = [(cfg.with_(faults=FaultConfig(n_byzantine=f)), s)\n"
+        "       for f in range(1) for s in (0, 1)]\n"
+        "print(chunk_key(canon, 3, pts))\n"
+    )
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": os.pathsep.join(
+               p for p in (os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))),
+                   os.environ.get("PYTHONPATH")) if p)}
+    out = subprocess.run([sys.executable, "-c", prog], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == local
+
+
+# ------------------------------------------------------- journal file IO ---
+
+
+def test_journal_roundtrip_and_events(tmp_path):
+    j = SweepJournal(str(tmp_path / "j.jsonl"))
+    rows = [{"a": 1, "b": [1.5, 2.0]}, {"a": 2, "b": []}]
+    j.append_chunk("k1", 0, rows, cache={"misses": 1})
+    j.append_event("k1", "deadline", attempt=1)
+    j2 = SweepJournal(j.path)
+    assert j2.completed() == {"k1": rows}
+    assert [e["event"] for e in j2.events()] == ["deadline"]
+    assert j2.chunk_lines()[0]["cache"] == {"misses": 1}
+
+
+def test_journal_append_after_torn_tail_repairs_it(tmp_path):
+    """A resume must not merge its first append into a crash's partial
+    line (losing both records): reopening terminates the torn tail
+    first, so the garbage parses (and is skipped) alone."""
+    j = SweepJournal(str(tmp_path / "j.jsonl"))
+    j.append_chunk("k1", 0, [{"x": 1}])
+    j.close()
+    with open(j.path, "a") as f:
+        f.write('{"sj": 1, "op": "chunk", "key": "k2", "rows": [{"x"')
+    j2 = SweepJournal(j.path)
+    j2.append_chunk("k3", 1, [{"x": 3}])
+    assert set(SweepJournal(j.path).completed()) == {"k1", "k3"}
+
+
+def test_journal_torn_tail_tolerated(tmp_path):
+    """A crash mid-append leaves an unparseable tail: the reader skips it
+    and serves every complete chunk — the chunk that owned the torn line
+    is simply recomputed (its key is absent)."""
+    j = SweepJournal(str(tmp_path / "j.jsonl"))
+    j.append_chunk("k1", 0, [{"x": 1}])
+    j.append_chunk("k2", 1, [{"x": 2}])
+    with open(j.path, "a") as f:
+        f.write('{"sj": 1, "op": "chunk", "key": "k3", "rows": [{"x": 3')
+    done = SweepJournal(j.path).completed()
+    assert set(done) == {"k1", "k2"}
+
+
+def test_journal_checksum_corruption_demotes_chunk(tmp_path):
+    """Bit rot inside a row: the stored checksum no longer matches, the
+    reader excludes the chunk (recompute, never wrong rows) and the
+    invariant checker reports it."""
+    j = SweepJournal(str(tmp_path / "j.jsonl"))
+    j.append_chunk("k1", 0, [{"x": 1}])
+    j.append_chunk("k2", 1, [{"x": 2}])
+    lines = open(j.path).read().splitlines()
+    patched = [ln.replace('"x":2', '"x":3') for ln in lines]
+    with open(j.path, "w") as f:
+        f.write("\n".join(patched) + "\n")
+    post = SweepJournal(j.path)
+    assert set(post.completed()) == {"k1"}
+    violations = invariants.check_sweep_journal(post)
+    assert any("checksum" in v for v in violations)
+
+
+def test_row_checksum_survives_json_roundtrip():
+    row = {"commits": 7, "ttf": [1.0, 2.5], "ok": True, "note": None}
+    assert row_checksum(json.loads(json.dumps(row))) == row_checksum(row)
+
+
+def test_check_sweep_journal_flags_duplicate_chunk(tmp_path):
+    j = SweepJournal(str(tmp_path / "j.jsonl"))
+    j.append_chunk("k1", 0, [{"x": 1}])
+    j.append_chunk("k1", 0, [{"x": 1}])
+    violations = invariants.check_sweep_journal(j)
+    assert any("journaled 2 times" in v for v in violations)
+
+
+def test_align_chunk():
+    assert partition.align_chunk(2, 8) == 8
+    assert partition.align_chunk(8, 8) == 8
+    assert partition.align_chunk(9, 8) == 16
+    assert partition.align_chunk(5, 1) == 5
+    assert partition.align_chunk(0, 4) == 4
+
+
+# ------------------------------------------------- journaled sweep paths ---
+
+
+def test_journaled_sweep_resume_skips_completed_chunks(tmp_path):
+    """THE resume pin: kill a journaled sweep after 2 of 3 chunks, rerun
+    it — ONE executable overall, misses unchanged on resume, only the
+    missing chunk appended, rows bit-equal to the un-journaled sweep."""
+    jp = str(tmp_path / "sweep.journal")
+    fcs = [FaultConfig(n_byzantine=f) for f in range(3)]
+    seeds = (0, 1)
+    ctl = inject.ChaosController(seed=0)
+    ctl.fail_next("sweep.chunk", n=1, exc=inject.ChaosKill,
+                  match=lambda c: c.get("index") == 2)
+    with ctl:
+        with pytest.raises(inject.ChaosKill):
+            run_fault_sweep(CFG, fcs, seeds, journal=SweepJournal(jp))
+    assert len(SweepJournal(jp).completed()) == 2
+    m0 = aotcache.registry.stats()["misses"]
+    resumed = run_fault_sweep(CFG, fcs, seeds, journal=SweepJournal(jp))
+    assert aotcache.registry.stats()["misses"] == m0, \
+        "resume must not compile"
+    assert len(SweepJournal(jp).completed()) == 3
+    reference = run_fault_sweep(CFG, fcs, seeds)
+    for fc in fcs:
+        assert _cjson(resumed[fc]) == _cjson(reference[fc])
+    post = SweepJournal(jp)
+    assert invariants.check_sweep_journal(
+        post, expected_keys=set(post.completed()),
+        expected_rows=len(fcs) * len(seeds)) == []
+
+
+def test_journaled_rows_are_not_rerecorded(tmp_path, monkeypatch):
+    """Resumed rows come from the journal, not a dispatch — they must not
+    double-append to runs.jsonl (the access-log analog of replay
+    marking)."""
+    runs = str(tmp_path / "runs.jsonl")
+    jp = str(tmp_path / "sweep.journal")
+    monkeypatch.setenv(obs.RUNS_ENV, runs)
+    pts = _points(2)
+    run_dyn_points(CANON, pts, journal=SweepJournal(jp), chunk_size=2)
+    n_first = len(obs.read_jsonl(runs))
+    assert n_first == len(pts)
+    run_dyn_points(CANON, pts, journal=SweepJournal(jp), chunk_size=2)
+    assert len(obs.read_jsonl(runs)) == n_first
+
+
+def test_journaled_mesh_sweep_bit_equal(tmp_path):
+    """The mesh arm journals too: chunk size aligns up to the sweep
+    lanes, keys embed the mesh descriptor, and resumed rows are bit-equal
+    to the single-device path (exact sampler)."""
+    from blockchain_simulator_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(n_node_shards=1, n_sweep=8)
+    jp = str(tmp_path / "mesh.journal")
+    pts = _points(4, seeds=(0, 1))  # 8 points = one aligned chunk
+    rows_mesh = run_dyn_points(CANON, pts, mesh=mesh,
+                               journal=SweepJournal(jp), chunk_size=2)
+    assert len(SweepJournal(jp).completed()) == 1  # 2 aligned up to 8
+    rows_resume = run_dyn_points(CANON, pts, mesh=mesh,
+                                 journal=SweepJournal(jp), chunk_size=2)
+    rows_single = run_dyn_points(CANON, pts)
+    assert _cjson(rows_mesh) == _cjson(rows_single)
+    assert _cjson(rows_resume) == _cjson(rows_single)
+    # a single-device journal of the same points must NOT collide with
+    # the mesh journal's chunks: the mesh rides the key
+    assert chunk_key(CANON, 0, pts[:8], mesh) != chunk_key(CANON, 0, pts[:8])
+
+
+def test_supervise_without_journal_still_supervises():
+    """supervise= must not silently require journal=: a failing primary
+    dispatch still walks retry → degrade and answers (just not
+    durably)."""
+    pts = _points(1)
+    reference = run_dyn_points(CANON, pts)
+    ctl = inject.ChaosController(seed=0)
+    ctl.fail_next("sweep.chunk", n=1,
+                  match=lambda c: c.get("arm") == "primary")
+    sup = ChunkSupervisor(deadline_s=None, retries=0, backoff_s=0.0,
+                          rng=lambda: 0.5)
+    with ctl:
+        rows = run_dyn_points(CANON, pts, supervise=sup)
+    assert _cjson(rows) == _cjson(reference)
+    assert ctl.schedule() == ["sweep.chunk:fail"]
+
+
+# ------------------------------------------------------------ supervisor ---
+
+
+def test_supervisor_deadline_retry_degrade_trail(tmp_path):
+    j = SweepJournal(str(tmp_path / "j.jsonl"))
+    calls = {"p": 0, "d": 0}
+
+    def primary():
+        calls["p"] += 1
+        time.sleep(0.4)
+        return ["late"]
+
+    def degrade():
+        calls["d"] += 1
+        return ["degraded"]
+
+    sup = ChunkSupervisor(deadline_s=0.05, retries=1, backoff_s=0.01,
+                          rng=lambda: 0.5)
+    rows, events = run_supervised(primary, degrade, sup, journal=j, key="k")
+    assert rows == ["degraded"]
+    assert events == ["deadline", "retry", "deadline", "degrade"]
+    assert [e["event"] for e in j.events()] == events
+    assert calls == {"p": 2, "d": 1}
+    journal_mod.drain_abandoned()
+
+
+def test_supervisor_error_retries_then_succeeds():
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] == 1:
+            raise RuntimeError("transient")
+        return ["ok"]
+
+    sup = ChunkSupervisor(deadline_s=None, retries=2, backoff_s=0.0,
+                          rng=lambda: 0.5)
+    rows, events = run_supervised(flaky, None, sup)
+    assert rows == ["ok"]
+    assert events == ["error", "retry"]
+
+
+def test_supervisor_exhaustion_is_typed(tmp_path):
+    j = SweepJournal(str(tmp_path / "j.jsonl"))
+
+    def bad():
+        raise RuntimeError("boom")
+
+    sup = ChunkSupervisor(deadline_s=None, retries=1, backoff_s=0.0,
+                          rng=lambda: 0.5)
+    with pytest.raises(ChunkFailedError):
+        run_supervised(bad, bad, sup, journal=j, key="k")
+    assert [e["event"] for e in j.events()] == \
+        ["error", "retry", "error", "degrade", "failed"]
+
+
+def test_supervised_sweep_checkpoint_degrade_arm(tmp_path):
+    """A 1-point chunk with a checkpoint dir wedges: the degrade arm runs
+    the sim through tick-level checkpoints (runner.run_dyn_checkpointed)
+    and the row is bit-equal to the direct dispatch."""
+    jp = str(tmp_path / "j.jsonl")
+    pt_cfg = CFG.with_(faults=FaultConfig(n_byzantine=2))
+    reference = run_dyn_points(CANON, [(pt_cfg, 5)])
+    ctl = inject.ChaosController(seed=0)
+    ctl.fail_next("sweep.chunk", n=2,
+                  match=lambda c: c.get("arm") == "primary")
+    sup = ChunkSupervisor(deadline_s=None, retries=1, backoff_s=0.0,
+                          checkpoint_dir=str(tmp_path / "ckpts"),
+                          checkpoint_every_ms=80, rng=lambda: 0.5)
+    with ctl:
+        rows = run_dyn_points(CANON, [(pt_cfg, 5)],
+                              journal=SweepJournal(jp), supervise=sup)
+    assert _cjson(rows) == _cjson(reference)
+    j = SweepJournal(jp)
+    assert [e["event"] for e in j.events()] == \
+        ["error", "retry", "error", "degrade"]
+    # the degrade arm really segmented: a checkpoint file exists
+    ck = list((tmp_path / "ckpts").rglob("ckpt_*.npz"))
+    assert len(ck) == 1
+
+
+# ------------------------------------------------------ health fail-fast ---
+
+
+def _write_health(path, verdict, ts=None):
+    rec = {"verdict": verdict, "probe_s": 1.0,
+           "ts": time.time() if ts is None else ts}
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def test_wedged_health_verdict_fails_sweep_fast(tmp_path, monkeypatch):
+    log = str(tmp_path / "HEALTH.jsonl")
+    _write_health(log, "wedged")
+    monkeypatch.setenv(health.HEALTH_ENV, log)
+    with pytest.raises(health.BackendWedgedError) as ei:
+        run_fault_sweep(CFG, [FaultConfig()], (0,))
+    assert ei.value.verdict["verdict"] == "wedged"
+
+
+def test_stale_or_healthy_verdicts_do_not_gate(tmp_path, monkeypatch):
+    log = str(tmp_path / "HEALTH.jsonl")
+    _write_health(log, "wedged", ts=time.time() - 7200)  # stale: ignored
+    monkeypatch.setenv(health.HEALTH_ENV, log)
+    assert health.require_not_wedged() is not None
+    _write_health(log, "healthy")  # newest verdict wins
+    assert health.require_not_wedged()["verdict"] == "healthy"
+    monkeypatch.delenv(health.HEALTH_ENV)
+    assert health.require_not_wedged() is None  # no log = no gate
+
+
+# ------------------------------------------------------------ slow drill ---
+
+
+@pytest.mark.slow
+def test_sweep_resume_drill_quick_cli(tmp_path):
+    """The lint.sh resume gate end to end: a REAL SIGKILL against a
+    journaled-sweep subprocess, resume recomputes no completed chunk,
+    rows bit-equal, resume_* trajectory rows land in runs.jsonl."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    runs = tmp_path / "runs.jsonl"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "sweep_resume_drill.py"),
+         "--quick"],
+        capture_output=True, text=True, timeout=560, cwd=repo,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "BLOCKSIM_RUNS_JSONL": str(runs)},
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["ok"] and summary["invariant_violations"] == 0
+    assert summary["kill9"]["killed"] is True
+    assert summary["kill9"]["recomputed_completed_chunks"] == 0
+    assert summary["kill9"]["rows_bit_equal"] is True
+    metrics = {r.get("metric") for r in obs.read_jsonl(str(runs))}
+    assert {"resume_invariant_violations", "resume_recomputed_chunks"} \
+        <= metrics
+
+
+# ----------------------------------------------------------- serve wiring ---
+
+
+def test_serve_journal_answers_replayed_batch_from_journal(tmp_path):
+    """A journaled server's batched flush lands one content-keyed chunk;
+    a fresh server on the same journal answers the identical batch from
+    it — one chunk line total, metrics equal (the WAL-replay recompute
+    saver)."""
+    from blockchain_simulator_tpu.serve import ScenarioServer
+
+    jp = str(tmp_path / "serve.journal")
+    tpl = {"protocol": "pbft", "n": 8, "sim_ms": 200,
+           "stat_sampler": "exact"}
+
+    def run_pair(tag):
+        with ScenarioServer(max_batch=2, max_wait_ms=50.0,
+                            journal_path=jp) as srv:
+            a = srv.submit(dict(tpl, seed=1, id=f"{tag}-a"))
+            b = srv.submit(dict(tpl, seed=2, id=f"{tag}-b"))
+            ra, rb = a.result(300), b.result(300)
+            assert srv.stats()["knobs"]["journal"] == jp
+        assert ra["status"] == rb["status"] == "ok"
+        assert ra["batch"]["mode"] == "batched"
+        return ra["metrics"], rb["metrics"]
+
+    first = run_pair("one")
+    assert len(SweepJournal(jp).chunk_lines()) == 1
+    second = run_pair("two")
+    assert len(SweepJournal(jp).chunk_lines()) == 1  # served from journal
+    assert _cjson(first) == _cjson(second)

@@ -130,8 +130,13 @@ def trajectory(rows: list[dict]) -> dict[str, list[dict]]:
 # counters ("chaos_invariant_violations"/"chaos_replay_divergence",
 # tools/chaos_drill.py) are the same shape: zero is the goal, any rise
 # already fails the drill's own exit code — chart, never gate.
+# The durable-sweep series ("journal_*" from mesh_sweep_bench --journal,
+# "resume_*" from tools/sweep_resume_drill.py) are the same shape again:
+# overhead pct and recompute counts are lower-is-better with their own
+# drill/bench exit codes, and a resume replaying MORE rows from the
+# journal means a fuller journal, not a regression — chart, never gate.
 UNGATED_SUFFIXES = ("_findings", "_compile_s", "_p50_ms")
-UNGATED_PREFIXES = ("graph_", "chaos_", "fleet_")
+UNGATED_PREFIXES = ("graph_", "chaos_", "fleet_", "journal_", "resume_")
 
 # Serving latency is lower-is-better AND gated: the serve smoke/bench land
 # a p99 trajectory (serve_p99_ms) whose REGRESSION is an increase, so the

@@ -19,6 +19,10 @@
 #     the replicated serving tier (replica death/WAL handoff, hedged
 #     failover, retry storm, double-claim) plus a 2-replica micro-bench
 #     (FLEET=0 skips);
+#   - the sweep resume drill (`tools/sweep_resume_drill.py --quick`)
+#     SIGKILLs a real journaled-sweep subprocess mid-grid and demands
+#     the resume recompute at most the in-flight chunk with rows
+#     bit-equal (RESUME=0 skips);
 #   - `tools/bench_compare.py` sees no metric drop beyond its threshold.
 #
 # When $BLOCKSIM_RUNS_JSONL is set the lint runs themselves land in
@@ -106,6 +110,25 @@ if [ "${FLEET:-1}" != "0" ]; then
     fleet_rc=$?
     if [ "$fleet_rc" -ne 0 ]; then
         echo "lint.sh: fleet drill FAILED (rc=$fleet_rc)" >&2
+        rc=1
+    fi
+fi
+
+# Sweep resume drill (tools/sweep_resume_drill.py --quick): a REAL
+# kill -9 against a journaled-sweep subprocess (parallel/journal.py) —
+# completed chunks must never recompute, the resumed journal must replay
+# bit-equal rows with zero dispatches, zero invariant violations; lands
+# resume_invariant_violations / resume_recomputed_chunks in runs.jsonl
+# (charted, never gated by bench_compare — the drill's own exit code is
+# the gate).  RESUME=0 skips (~20 s on the 1-core box); the full-scale
+# artifact run is `python tools/sweep_resume_drill.py` and the committed
+# ARTIFACT_resume_sweep.json.
+if [ "${RESUME:-1}" != "0" ]; then
+    echo "== sweep resume drill =="
+    python tools/sweep_resume_drill.py --quick
+    resume_rc=$?
+    if [ "$resume_rc" -ne 0 ]; then
+        echo "lint.sh: sweep resume drill FAILED (rc=$resume_rc)" >&2
         rc=1
     fi
 fi

@@ -298,6 +298,40 @@ def build_catalog() -> list[ProgramSpec]:
         "partition_dynf.pbft_nodes", {"n_byzantine": 1}, 1, 2,
         None, True))
 
+    # --- parallel/sweep.multi_seed_fn ("multi-seed-tick") ---------------
+    # The single-device multi-seed Monte Carlo executable: lax.map over the
+    # UNVMAPPED dyn sim (ISSUE 13).  Its whole reason to exist is the
+    # scatter-free body (#0i), so its budget entry carries NO baselined
+    # scatter findings — any scatter lowering in this program is a NEW
+    # slow-lowering-confirmed finding and fails the gate.  Divergence
+    # twins: fault-count (and seed — canonical_fault_cfg normalizes it)
+    # changes at one seed count must share ONE fingerprint, so a sweep
+    # tile's level never mints a second executable.
+    def multi_seed_spec(name, arm, fc_kw, seed, group, budget):
+        def build():
+            import dataclasses as _dc
+
+            from blockchain_simulator_tpu.parallel import sweep
+
+            cfg = cfgs[arm].with_(seed=seed)
+            cfg = cfg.with_(faults=_dc.replace(cfg.faults, **fc_kw))
+            from blockchain_simulator_tpu.models.base import canonical_fault_cfg
+
+            fn = _raw(sweep.multi_seed_fn)(canonical_fault_cfg(cfg), 2)
+            return fn, (_keys_sds(2), _i32_sds((2,)), _i32_sds((2,)))
+
+        return ProgramSpec(name, "multi-seed-tick", build,
+                           divergence_group=group, budget=budget)
+
+    specs.append(multi_seed_spec("multi_seed.pbft", "pbft_tick",
+                                 {"n_byzantine": 1}, 0,
+                                 "multi-seed:pbft_tick", True))
+    specs.append(multi_seed_spec("multi_seed.pbft_b2_s7", "pbft_tick",
+                                 {"n_byzantine": 2}, 7,
+                                 "multi-seed:pbft_tick", False))
+    specs.append(multi_seed_spec("multi_seed.raft", "raft_tick",
+                                 {"n_crashed": 1}, 0, None, True))
+
     # --- serve/dispatch._solo_fn ("serve-solo") -------------------------
     # The scenario server's un-vmapped degrade/solo path.  Divergence
     # twins mirror the dynf pair: requests differing only in fault counts

@@ -192,7 +192,8 @@ def init(cfg, key=None):
     return state, bufs
 
 
-def _req_contrib(key, val_local, lo, hi, drop, axis, ids, p, ref_skip):
+def _req_contrib(key, val_local, lo, hi, drop, axis, ids, p, ref_skip,
+                 impl="threefry"):
     """Broadcast contribution for one request channel: local per-node request
     values (nonzero only at proposer rows) → [B, N_loc, P] value tensor for
     ``ring_push_max``.  ``ref_skip`` drops the sender's first peer (the
@@ -200,7 +201,7 @@ def _req_contrib(key, val_local, lo, hi, drop, axis, ids, p, ref_skip):
     n_loc = val_local.shape[0]
     val_g = dv._gather(val_local, axis)[:p]  # [P] global proposer values
     k = dv._shard_key(key, axis)
-    d = delay_ops.sample_edge_delays(k, (n_loc, p), lo, hi)
+    d = delay_ops.sample_edge_delays(k, (n_loc, p), lo, hi, impl)
     prop_ids = jnp.arange(p)
     mask = (val_g[None, :] > 0) & (ids[:, None] != prop_ids[None, :])
     if ref_skip:
@@ -212,18 +213,22 @@ def _req_contrib(key, val_local, lo, hi, drop, axis, ids, p, ref_skip):
         )
         mask = mask & keep
     m = mask.astype(jnp.int32)
-    return jnp.stack(
-        [(d == lo + b).astype(jnp.int32) * m * val_g[None, :] for b in range(hi - lo)]
+    return (
+        (d[None] == dv._bucket_iota(lo, hi, d.ndim)).astype(jnp.int32)
+        * (m * val_g[None, :])[None]
     )
 
 
-def _gossip_fwd_contrib(key, fwd_vals, nbrs_loc, n_glob, lo, hi, drop, axis):
+def _gossip_fwd_contrib(key, fwd_vals, nbrs_loc, n_glob, lo, hi, drop, axis,
+                        impl="threefry"):
     """TTL-flood forwarding for the three request channels — shared op
     (ops/delivery.gossip_fwd), P = proposer lanes here."""
-    return dv.gossip_fwd(key, fwd_vals, nbrs_loc, n_glob, lo, hi, drop, axis)
+    return dv.gossip_fwd(key, fwd_vals, nbrs_loc, n_glob, lo, hi, drop, axis,
+                         impl=impl)
 
 
-def _reply_contribs(key, ok_wire, no_wire, cmd_wire, lo, hi, drop, axis, ids, p):
+def _reply_contribs(key, ok_wire, no_wire, cmd_wire, lo, hi, drop, axis, ids, p,
+                    impl="threefry"):
     """Unicast acceptor→proposer replies: per-(acceptor, proposer, type) wires
     → (ok [B, N_loc, 3], no [B, N_loc, 3], cmd [B, N_loc]) contributions at
     the *local* proposer rows.  Each reply is its own packet with its own delay
@@ -231,7 +236,7 @@ def _reply_contribs(key, ok_wire, no_wire, cmd_wire, lo, hi, drop, axis, ids, p)
     Sharded, counts psum / payloads pmax across shards (the repliers)."""
     n_loc = ok_wire.shape[0]
     k = dv._shard_key(key, axis)
-    d = delay_ops.sample_edge_delays(k, (n_loc, p, 3), lo, hi)
+    d = delay_ops.sample_edge_delays(k, (n_loc, p, 3), lo, hi, impl)
     if drop > 0.0:
         keep = jax.random.bernoulli(
             jax.random.fold_in(k, 0x0D21), 1.0 - drop, (n_loc, p, 3)
@@ -239,16 +244,12 @@ def _reply_contribs(key, ok_wire, no_wire, cmd_wire, lo, hi, drop, axis, ids, p)
         ok_wire = ok_wire * keep
         no_wire = no_wire * keep
         cmd_wire = cmd_wire * keep[:, :, 0]
-    nb = hi - lo
-    ok_b = jnp.stack(
-        [((d == lo + b).astype(jnp.int32) * ok_wire).sum(0) for b in range(nb)]
-    )  # [B, P, 3]
-    no_b = jnp.stack(
-        [((d == lo + b).astype(jnp.int32) * no_wire).sum(0) for b in range(nb)]
-    )
-    cmd_b = jnp.stack(
-        [((d[:, :, 0] == lo + b).astype(jnp.int32) * cmd_wire).max(0) for b in range(nb)]
-    )  # [B, P]
+    # one broadcast compare per channel instead of nb masked passes over the
+    # [N_loc, P, 3] wire tensors (integer reductions — bit-equal either way)
+    hits = (d[None] == dv._bucket_iota(lo, hi, d.ndim)).astype(jnp.int32)
+    ok_b = (hits * ok_wire[None]).sum(1)  # [B, P, 3]
+    no_b = (hits * no_wire[None]).sum(1)
+    cmd_b = (hits[:, :, :, 0] * cmd_wire[None]).max(1)  # [B, P]
     if axis is not None:
         ok_b = jax.lax.psum(ok_b, axis)
         no_b = jax.lax.psum(no_b, axis)
@@ -268,6 +269,7 @@ def step(cfg, state: PaxosState, bufs: PaxosBufs, t, tkey):
     lo, hi = cfg.one_way_range()
     drop = cfg.faults.drop_prob
     clean = cfg.fidelity == "clean"
+    eimpl = cfg.eff_edge_sampler
     c_enc = n + 1  # encoding base: val = ticket * c_enc + command + 1
     n_loc = state.t_max.shape[0]
     ids = dv._global_ids(n_loc, axis)
@@ -358,7 +360,8 @@ def step(cfg, state: PaxosState, bufs: PaxosBufs, t, tkey):
     zeros_cmd = jnp.zeros((nb, n_loc), jnp.int32)
     ok_c, no_c, cmd_c = gated(
         any_req,
-        lambda: _reply_contribs(k_r, ok_w, no_w, cmd_wire, lo, hi, drop, axis, ids, p),
+        lambda: _reply_contribs(k_r, ok_w, no_w, cmd_wire, lo, hi, drop, axis,
+                                ids, p, impl=eimpl),
         (zeros_ok, zeros_ok, zeros_cmd),
         axis,
     )
@@ -528,7 +531,8 @@ def step(cfg, state: PaxosState, bufs: PaxosBufs, t, tkey):
             contribs.append(gated(
                 (enc > 0).any(),
                 lambda e=enc, c=chan: _gossip_fwd_contrib(
-                    chan_key(tkey, c), e, nbrs_loc, n, lo, hi, drop, axis
+                    chan_key(tkey, c), e, nbrs_loc, n, lo, hi, drop, axis,
+                    impl=eimpl,
                 ),
                 zeros_req,
                 axis,
@@ -538,7 +542,8 @@ def step(cfg, state: PaxosState, bufs: PaxosBufs, t, tkey):
             contribs.append(gated(
                 (val > 0).any(),
                 lambda v=val, c=chan: _req_contrib(
-                    chan_key(tkey, c), v, lo, hi, drop, axis, ids, p, ref_skip
+                    chan_key(tkey, c), v, lo, hi, drop, axis, ids, p, ref_skip,
+                    impl=eimpl,
                 ),
                 zeros_req,
                 axis,

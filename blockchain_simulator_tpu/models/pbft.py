@@ -248,6 +248,7 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     clean = cfg.fidelity == "clean"
     stat = cfg.delivery == "stat"
     smode = cfg.eff_stat_sampler
+    eimpl = cfg.eff_edge_sampler
     ow_probs = delay_ops.uniform_probs(lo, hi)
     rt_probs = delay_ops.roundtrip_probs(lo, hi)
     n_loc = state.v.shape[0]
@@ -373,32 +374,43 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     voters = state.alive & state.honest
     k_rt = chan_key(tkey, Channel.DELAY_ROUNDTRIP)
     prep_active = got_pp.any(axis=1)
+    got_pp_i = got_pp.astype(jnp.int32)
     if stat:
+        # fused sample-and-push (ops/delivery.push_roundtrip_reply_counts_
+        # stat): each reply bucket's chain math lands straight in its ring
+        # slice — bit-equal to the unfused sample → expand → ring_push_add
+        # compose, without the [B2, N, W] stacked intermediate.  The gated
+        # fallback returns the ring UNTOUCHED, which is what pushing an
+        # all-zero contribution produced.
         n_voters = voters.astype(jnp.int32).sum()
         if axis is not None:
             n_voters = jax.lax.psum(n_voters, axis)
-        rt_counts = gated(
+        prep_rt = gated(
             prep_active.any(),
-            lambda: dv.roundtrip_reply_counts_stat(
-                k_rt, prep_active, n_voters - voters.astype(jnp.int32), rt_probs,
-                drop, axis=axis, mode=smode,
+            lambda: dv.push_roundtrip_reply_counts_stat(
+                prep_rt, t, rt_lo, k_rt, prep_active,
+                n_voters - voters.astype(jnp.int32), rt_probs, drop,
+                axis=axis, mode=smode,
+                # replies are per broadcast, i.e. per active (node, window)
+                expand=lambda c: c[:, None] * got_pp_i,
             ),
-            jnp.zeros((len(rt_probs), n_loc), jnp.int32),
+            prep_rt,
             axis,
         )
     else:
         rt_counts = gated(
             prep_active.any(),
             lambda: dv.roundtrip_reply_counts_dense(
-                k_rt, prep_active, lo, hi, drop, peer_mask=voters, axis=axis
+                k_rt, prep_active, lo, hi, drop, peer_mask=voters, axis=axis,
+                impl=eimpl,
             ),
             jnp.zeros((len(rt_probs), n_loc), jnp.int32),
             axis,
         )
-    # replies are per broadcast, i.e. per active (node, window)
-    prep_rt = ring_push_add(
-        prep_rt, t, rt_lo, rt_counts[:, :, None] * got_pp.astype(jnp.int32)[None, :, :]
-    )
+        # replies are per broadcast, i.e. per active (node, window)
+        prep_rt = ring_push_add(
+            prep_rt, t, rt_lo, rt_counts[:, :, None] * got_pp_i[None, :, :]
+        )
 
     # ---- PREPARE_RES arrivals → prepare_vote → COMMIT broadcast -------------
     pv = prepare_vote + prep_t
@@ -428,21 +440,25 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     k_cm = chan_key(tkey, Channel.DELAY_BCAST)
     zeros_w = jnp.zeros((hi - lo, n_loc, w), jnp.int32)
     if stat:
-        cm_contrib = gated(
+        # fused chain-into-ring (see the prep_rt channel above)
+        commit = gated(
             (commit_mat > 0).any(),
-            lambda: dv.bcast_slots_stat(k_cm, commit_mat, ow_probs, drop, axis=axis,
-                                        mode=smode),
-            zeros_w,
+            lambda: dv.push_bcast_slots_stat(
+                commit, t, lo, k_cm, commit_mat, ow_probs, drop, axis=axis,
+                mode=smode,
+            ),
+            commit,
             axis,
         )
     else:
         cm_contrib = gated(
             (commit_mat > 0).any(),
-            lambda: dv.bcast_slots_dense(k_cm, commit_mat, lo, hi, drop, axis=axis),
+            lambda: dv.bcast_slots_dense(k_cm, commit_mat, lo, hi, drop, axis=axis,
+                                         impl=eimpl),
             zeros_w,
             axis,
         )
-    commit = ring_push_add(commit, t, lo, cm_contrib)
+        commit = ring_push_add(commit, t, lo, cm_contrib)
 
     # ---- COMMIT arrivals → commit_vote → finality ---------------------------
     cv = commit_vote + com_t
@@ -541,7 +557,7 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
         pp_contrib = gated(
             (pp_out > 0).any(),
             lambda: dv.gossip_fwd(k_pp, pp_out, nbrs_loc, n, lo, hi, drop,
-                                  axis=axis),
+                                  axis=axis, impl=eimpl),
             zeros_w,
             axis,
         )
@@ -557,7 +573,7 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
         pp_contrib = gated(
             send_block.any(),
             lambda: dv.bcast_window_value_max_dense(k_pp, pp_val, lo, hi, drop,
-                                                    axis=axis),
+                                                    axis=axis, impl=eimpl),
             zeros_w,
             axis,
         )
@@ -592,7 +608,7 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
         vc_contrib = gated(
             (vc_out > 0).any(),
             lambda: dv.gossip_fwd(k_vc, vc_out[:, None], nbrs_loc, n, lo, hi,
-                                  drop, axis=axis)[:, :, 0],
+                                  drop, axis=axis, impl=eimpl)[:, :, 0],
             zeros_flat,
             axis,
         )
@@ -606,7 +622,8 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     else:
         vc_contrib = gated(
             trigger.any(),
-            lambda: dv.bcast_value_max_dense(k_vc, trigger, enc, lo, hi, drop, axis=axis),
+            lambda: dv.bcast_value_max_dense(k_vc, trigger, enc, lo, hi, drop,
+                                             axis=axis, impl=eimpl),
             zeros_flat,
             axis,
         )

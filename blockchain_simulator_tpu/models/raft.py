@@ -218,6 +218,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
     clean = cfg.fidelity == "clean"
     stat = cfg.delivery == "stat"
     smode = cfg.eff_stat_sampler
+    eimpl = cfg.eff_edge_sampler
     ow_probs = delay_ops.uniform_probs(lo, hi)
     rt_probs = delay_ops.roundtrip_probs(lo, hi)
     n_loc = state.is_leader.shape[0]
@@ -319,7 +320,12 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
                 c = jax.lax.dynamic_slice_in_dim(c, start, n_loc)
             return c
 
-        def _ack_buckets():
+        def _push_acks():
+            # fused chain-into-ring (ops/delivery.push_bucket_counts):
+            # bit-equal to the former stacked sample → ring_push_add pair
+            # (same keys, same chain, same adds), minus the [2, B, N]
+            # intermediate; the gated fallback leaves the rings untouched,
+            # which is what pushing all-zero contributions produced
             mok = _ack_counts(got_prop & state.honest & state.alive)
             mbad = _ack_counts(got_prop & ~state.honest & state.alive)
             if drop > 0.0:
@@ -329,19 +335,18 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
                 mbad = jnp.round(delay_ops.binom(
                     jax.random.fold_in(kd, 1), mbad, 1.0 - drop,
                     smode)).astype(jnp.int32)
-            return jnp.stack([
-                delay_ops.sample_bucket_counts(
-                    jax.random.fold_in(k_ack, 1), mok, ow_probs, smode),
-                delay_ops.sample_bucket_counts(
-                    jax.random.fold_in(k_ack, 2), mbad, ow_probs, smode),
-            ])
+            return (
+                dv.push_bucket_counts(
+                    hb_ok, t, lo, jax.random.fold_in(k_ack, 1), mok,
+                    ow_probs, smode),
+                dv.push_bucket_counts(
+                    hb_bad, t, lo, jax.random.fold_in(k_ack, 2), mbad,
+                    ow_probs, smode),
+            )
 
-        both_acks = gated(
-            got_prop.any(), _ack_buckets,
-            jnp.zeros((2, hi - lo, n_loc), jnp.int32), axis,
+        hb_ok, hb_bad = gated(
+            got_prop.any(), _push_acks, (hb_ok, hb_bad), axis,
         )
-        hb_ok = ring_push_add(hb_ok, t, lo, both_acks[0])
-        hb_bad = ring_push_add(hb_bad, t, lo, both_acks[1])
 
     # ---- vote requests (acceptor side, raft-node.cc:154-167) ---------------
     can_grant = ~state.has_voted & state.alive
@@ -391,7 +396,8 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
         any_req = has_req.any()
         k_vr = chan_key(tkey, Channel.DELAY_REPLY)
 
-        def reply_buckets():
+        def push_replies():
+            # fused chain-into-ring — see the gossip ack block above
             mok = reply_counts(ok_wire)
             mno = reply_counts(no_wire)
             if drop > 0.0:
@@ -401,19 +407,18 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
                 mno = jnp.round(delay_ops.binom(
                     jax.random.fold_in(kd, 1), mno, 1.0 - drop,
                     smode)).astype(jnp.int32)
-            return jnp.stack([
-                delay_ops.sample_bucket_counts(
-                    jax.random.fold_in(k_vr, 7), mok, ow_probs, smode),
-                delay_ops.sample_bucket_counts(
-                    jax.random.fold_in(k_vr, 8), mno, ow_probs, smode),
-            ])
+            return (
+                dv.push_bucket_counts(
+                    vres_ok, t, lo, jax.random.fold_in(k_vr, 7), mok,
+                    ow_probs, smode),
+                dv.push_bucket_counts(
+                    vres_no, t, lo, jax.random.fold_in(k_vr, 8), mno,
+                    ow_probs, smode),
+            )
 
-        both = gated(
-            any_req, reply_buckets,
-            jnp.zeros((2, hi - lo, n_loc), jnp.int32), axis,
+        vres_ok, vres_no = gated(
+            any_req, push_replies, (vres_ok, vres_no), axis,
         )
-        vres_ok = ring_push_add(vres_ok, t, lo, both[0])
-        vres_no = ring_push_add(vres_no, t, lo, both[1])
     else:
         # vreq_t[i, j] = 1 iff candidate j's request reaches i this tick.
         # Concurrent same-tick requests: the vote goes to the lowest candidate
@@ -436,9 +441,11 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
             any_req.any(),
             lambda: jnp.stack([
                 dv.unicast_reply_counts_dense(
-                    jax.random.fold_in(k_vr, 7), ok_wire, lo, hi, drop, axis=axis),
+                    jax.random.fold_in(k_vr, 7), ok_wire, lo, hi, drop,
+                    axis=axis, impl=eimpl),
                 dv.unicast_reply_counts_dense(
-                    jax.random.fold_in(k_vr, 8), no_wire, lo, hi, drop, axis=axis),
+                    jax.random.fold_in(k_vr, 8), no_wire, lo, hi, drop,
+                    axis=axis, impl=eimpl),
             ]),
             jnp.zeros((2, hi - lo, n_loc), jnp.int32),
             axis,
@@ -570,7 +577,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
         vq_contrib = gated(
             (out_v > 0).any(),
             lambda: dv.gossip_fwd(k_vq, out_v[:, None], nbrs_loc, n, lo, hi,
-                                  drop, axis=axis)[:, :, 0],
+                                  drop, axis=axis, impl=eimpl)[:, :, 0],
             zeros_flat,
             axis,
         )
@@ -589,7 +596,8 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
         vq_contrib = gated(
             fire.any(),
             lambda: dv.bcast_matrix_dense(
-                k_vq, fire, fire.astype(jnp.int32), lo, hi, drop, axis=axis),
+                k_vq, fire, fire.astype(jnp.int32), lo, hi, drop, axis=axis,
+                impl=eimpl),
             jnp.zeros((hi - lo, n_loc, n), jnp.int32),
             axis,
         )
@@ -673,7 +681,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
             (out_h > 0).any(),
             lambda: dv.gossip_fwd(
                 jax.random.fold_in(k_hb, 2), out_h[:, None], nbrs_loc, n, lo,
-                hi, drop, axis=axis)[:, :, 0],
+                hi, drop, axis=axis, impl=eimpl)[:, :, 0],
             zeros_flat,
             axis,
         )
@@ -688,7 +696,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
             (out_p > 0).any(),
             lambda: dv.gossip_fwd(
                 jax.random.fold_in(k_hb, 3), out_p[:, None], nbrs_loc, n, lo,
-                hi, drop, axis=axis)[:, :, 0],
+                hi, drop, axis=axis, impl=eimpl)[:, :, 0],
             zeros_flat,
             axis,
         )
@@ -720,7 +728,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
         plain_contrib = gated(
             plain_send.any(),
             lambda: dv.bcast_counts_dense(k_hb, plain_send, lo, hi, drop,
-                                          axis=axis),
+                                          axis=axis, impl=eimpl),
             zeros_flat,
             axis,
         )
@@ -729,7 +737,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
             lambda: dv.bcast_value_max_dense(
                 jax.random.fold_in(k_hb, 1), prop_send,
                 (ids + 1) * prop_send.astype(jnp.int32), lo, hi, drop,
-                axis=axis),
+                axis=axis, impl=eimpl),
             zeros_flat,
             axis,
         )
@@ -778,30 +786,31 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
         hb_ok = hb_ok.at[:, col_c].add(jnp.where(owned, hist_ok, 0))
         hb_bad = hb_bad.at[:, col_c].add(jnp.where(owned, hist_bad, 0))
     elif stat:
+        # fused chain-into-ring (ops/delivery.push_roundtrip_reply_counts_
+        # stat) — bit-equal to the former sample → ring_push_add compose
         n_voters = _psum_scalar(voters.astype(jnp.int32).sum(), axis)
         n_liars = _psum_scalar(liars.astype(jnp.int32).sum(), axis)
-        ok_counts = gated(
+        hb_ok, hb_bad = gated(
             prop_send.any(),
-            lambda: dv.roundtrip_reply_counts_stat(
-                k_rt, prop_send, n_voters - voters.astype(jnp.int32),
-                rt_probs, drop, axis=axis, mode=smode),
-            zeros_rt,
-            axis,
-        )
-        bad_counts = gated(
-            prop_send.any(),
-            lambda: dv.roundtrip_reply_counts_stat(
-                jax.random.fold_in(k_rt, 1), prop_send,
-                n_liars - liars.astype(jnp.int32), rt_probs, drop,
-                axis=axis, mode=smode),
-            zeros_rt,
+            lambda: (
+                dv.push_roundtrip_reply_counts_stat(
+                    hb_ok, t, rt_lo + ser, k_rt, prop_send,
+                    n_voters - voters.astype(jnp.int32), rt_probs, drop,
+                    axis=axis, mode=smode),
+                dv.push_roundtrip_reply_counts_stat(
+                    hb_bad, t, rt_lo + ser, jax.random.fold_in(k_rt, 1),
+                    prop_send, n_liars - liars.astype(jnp.int32), rt_probs,
+                    drop, axis=axis, mode=smode),
+            ),
+            (hb_ok, hb_bad),
             axis,
         )
     else:
         ok_counts = gated(
             prop_send.any(),
             lambda: dv.roundtrip_reply_counts_dense(
-                k_rt, prop_send, lo, hi, drop, peer_mask=voters, axis=axis),
+                k_rt, prop_send, lo, hi, drop, peer_mask=voters, axis=axis,
+                impl=eimpl),
             zeros_rt,
             axis,
         )
@@ -809,11 +818,10 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
             prop_send.any(),
             lambda: dv.roundtrip_reply_counts_dense(
                 jax.random.fold_in(k_rt, 1), prop_send, lo, hi, drop,
-                peer_mask=liars, axis=axis),
+                peer_mask=liars, axis=axis, impl=eimpl),
             zeros_rt,
             axis,
         )
-    if not gossip and not queued:
         hb_ok = ring_push_add(hb_ok, t, rt_lo + ser, ok_counts)
         hb_bad = ring_push_add(hb_bad, t, rt_lo + ser, bad_counts)
 

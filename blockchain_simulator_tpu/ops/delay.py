@@ -32,9 +32,71 @@ def roundtrip_probs(lo: int, hi: int) -> np.ndarray:
     return np.convolve(p, p)
 
 
-def sample_edge_delays(key: jax.Array, shape, lo: int, hi: int) -> jax.Array:
-    """One delay per edge, in [lo, hi)."""
-    return jax.random.randint(key, shape, lo, hi, dtype=jnp.int32)
+def _rbg_key(key: jax.Array) -> jax.Array:
+    """Derive an ``rbg``-impl key (XLA's RngBitGenerator — far cheaper bit
+    generation than threefry on XLA:CPU) from WHATEVER impl the caller's key
+    uses: threefry keys hold 2 words, rbg/unsafe_rbg 4; tile-then-slice
+    reduces to the identity for 4-word keys and to ``tile(kd, 2)`` for
+    threefry.  Shared by :func:`_fast_normal` and the ``"rbg"`` edge
+    sampler; the source key is already per-channel/per-tick folded, so
+    streams stay decorrelated."""
+    kd = jnp.ravel(jax.random.key_data(key))
+    return jax.random.wrap_key_data(jnp.tile(kd, 4)[:4], impl="rbg")
+
+
+def sample_edge_delays(key: jax.Array, shape, lo: int, hi: int,
+                       impl: str = "threefry") -> jax.Array:
+    """One delay per edge, in [lo, hi).
+
+    ``impl`` selects the bit source (``SimConfig.edge_sampler``):
+
+    - ``"threefry"`` (default): ``jax.random.randint`` on the caller's key —
+      the historical stream every seed-pinned edge-path test rides.
+    - ``"rbg"``: the same *exact-uniform integer* map fed by cheap
+      RngBitGenerator words (the ``_fast_normal`` trick, minus the CLT):
+      when the span ``hi - lo`` is a power of two <= 2^16, each 32-bit word
+      bit-slices into TWO independent 16-bit fields and a mask — exactly
+      uniform at half the generated bits; otherwise full 32-bit words map
+      through the same shift-and-remainder construction
+      ``jax.random.randint`` uses (bias <= span * 2^-32, identical class).
+      Either way the map is pure integer arithmetic, so the repo's bit
+      contract holds across differently-compiled UNBATCHED programs: the
+      SAME key gives the SAME delays under jit, eager, ``lax.map`` lanes
+      and mesh per-device bodies (the multi-seed/mesh sweep arms) — unlike
+      the float ``"normal"`` stat mode's reassociation latitude
+      (parallel/sweep.py).  One scoped caveat, shared with
+      :func:`_fast_normal`: XLA's RngBitGenerator is NOT batch-invariant
+      under ``vmap`` — a vmapped lane (other than lane 0) draws different
+      bits than the same key unbatched, so vmap-vs-solo bit-equality pins
+      must keep ``edge_sampler="threefry"`` exactly as they must keep
+      ``stat_sampler="exact"``.  The stream DIFFERS from ``"threefry"``
+      (same distribution), so the toggle is a config field, never an
+      implicit swap.
+    """
+    if impl == "threefry":
+        return jax.random.randint(key, shape, lo, hi, dtype=jnp.int32)
+    if impl != "rbg":
+        raise ValueError(f"unknown edge sampler impl {impl!r}")
+    span = hi - lo
+    rbg = _rbg_key(key)
+    if span & (span - 1) == 0 and span <= (1 << 16):
+        # power-of-two span: mask 16-bit fields — exactly uniform, and each
+        # generated word yields two independent draws (disjoint bit fields)
+        if not shape:
+            return sample_edge_delays(key, (1,), lo, hi, impl)[0]
+        r = shape[0]
+        words = jax.random.bits(
+            rbg, ((r + 1) // 2,) + tuple(shape[1:]), jnp.uint32
+        )
+        fields = jnp.concatenate(
+            [words & jnp.uint32(0xFFFF), words >> 16], axis=0
+        )[:r]
+        return (lo + (fields & jnp.uint32(span - 1))).astype(jnp.int32)
+    # general span: full 32-bit words through randint's own construction
+    # (remainder over the word range) — bias <= span * 2^-32, the same
+    # class jax.random.randint documents for non-power-of-two spans
+    words = jax.random.bits(rbg, tuple(shape), jnp.uint32)
+    return (lo + (words % jnp.uint32(span))).astype(jnp.int32)
 
 
 def _fast_normal(key: jax.Array, shape) -> jax.Array:
@@ -60,12 +122,7 @@ def _fast_normal(key: jax.Array, shape) -> jax.Array:
     throughput (424 rounds/s single-core)."""
     if not shape:
         return _fast_normal(key, (1,))[0]
-    # derive exactly the 4 words an rbg key wants from WHATEVER impl the
-    # caller's key uses (threefry: 2 words; rbg/unsafe_rbg: 4; tile-then-
-    # slice reduces to the identity for 4-word keys and to tile(kd, 2) for
-    # threefry)
-    kd = jnp.ravel(jax.random.key_data(key))
-    rbg = jax.random.wrap_key_data(jnp.tile(kd, 4)[:4], impl="rbg")
+    rbg = _rbg_key(key)
     r = shape[0]
     words = jax.random.bits(rbg, ((r + 1) // 2,) + tuple(shape[1:]), jnp.uint32)
     lo = jax.lax.population_count(words & jnp.uint32(0xFFFF))
@@ -112,7 +169,35 @@ def sample_bucket_counts(key: jax.Array, n: jax.Array, probs: np.ndarray,
       per-bucket work is then ~5 cheap elementwise ops, which is what makes
       the sampler-bound round fast path viable on the XLA:CPU fallback
       (the per-bucket variant measured ~3x slower end-to-end there).
+
+    The ``"exact"`` chain mirrors the single-derivation trick at the key
+    level: per-bucket keys come from ONE batched ``vmap(fold_in)`` pass
+    over the bucket axis instead of a scalar ``fold_in(key, b)`` inside the
+    loop — one fused threefry dispatch for the whole chain.  ``vmap`` of
+    ``fold_in`` is bit-identical to the per-bucket scalar calls (fold_in is
+    an elementwise threefry of the folded constant), so the exact stream —
+    and every seed-pinned bit-equality test riding it — is unchanged; a
+    ``jax.random.split``-based hoist would have been equally fused but
+    minted a brand-new stream, moving every pinned trajectory for zero
+    additional win (moments are identical either way — the per-bucket keys
+    are independent uniforms in both constructions).  Only the BTRS
+    rejection passes themselves remain per-bucket; they are inherently
+    sequential (each bucket's ``n`` is the previous bucket's remainder).
     """
+    return jnp.stack(list(bucket_count_chain(key, n, probs, mode))).astype(
+        jnp.int32
+    )
+
+
+def bucket_count_chain(key: jax.Array, n: jax.Array, probs: np.ndarray,
+                       mode: str = "exact"):
+    """The conditional-binomial chain behind :func:`sample_bucket_counts`,
+    yielded one bucket at a time (float32, shape ``n.shape``) so callers can
+    fuse each bucket's sampler math into its consumer without materializing
+    the stacked ``[B, ...]`` tensor — ops/delivery.py's fused ring pushes
+    combine bucket ``b`` into its ring slice as it is produced.  Yields the
+    EXACT values :func:`sample_bucket_counts` stacks (same keys, same
+    arithmetic, same order), so fused and unfused consumers are bit-equal."""
     n = jnp.asarray(n, jnp.float32)
     nb = len(probs)
     # the last bucket is always the remainder — it never consumes a draw
@@ -120,7 +205,10 @@ def sample_bucket_counts(key: jax.Array, n: jax.Array, probs: np.ndarray,
         _fast_normal(key, (max(nb - 1, 1),) + n.shape)
         if mode == "normal" else None
     )
-    counts = []
+    keys = (
+        jax.vmap(lambda b: jax.random.fold_in(key, b))(jnp.arange(max(nb - 1, 1)))
+        if mode != "normal" and nb > 1 else None
+    )
     remaining = n
     p_left = 1.0
     for b, pb in enumerate(probs):
@@ -132,8 +220,7 @@ def sample_bucket_counts(key: jax.Array, n: jax.Array, probs: np.ndarray,
             sigma = jnp.sqrt(jnp.maximum(mu * (1.0 - frac), 0.0))
             c = jnp.clip(jnp.round(mu + sigma * z_all[b]), 0.0, remaining)
         else:
-            c = binom(jax.random.fold_in(key, b), remaining, frac, mode)
-        counts.append(c)
+            c = binom(keys[b], remaining, frac, mode)
+        yield c
         remaining = remaining - c
         p_left -= pb
-    return jnp.stack(counts).astype(jnp.int32)

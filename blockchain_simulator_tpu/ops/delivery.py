@@ -27,7 +27,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from blockchain_simulator_tpu.ops.delay import binom, sample_bucket_counts, sample_edge_delays
+from blockchain_simulator_tpu.ops.delay import (
+    binom,
+    bucket_count_chain,
+    sample_bucket_counts,
+    sample_edge_delays,
+)
 
 
 def _shard_key(key, axis):
@@ -51,17 +56,27 @@ def _global_ids(n_loc: int, axis):
     return base + jnp.arange(n_loc)
 
 
+def _bucket_iota(lo: int, hi: int, ndim: int):
+    """``[B, 1, ...]`` bucket values ``lo..hi-1`` broadcastable against a
+    rank-``ndim`` delay tensor — the vectorized replacement for the
+    per-bucket ``d == lo + b`` python loops, which XLA:CPU compiled as B
+    separate compare+select passes over the edge tensor; one broadcast
+    compare fuses into a single traversal."""
+    return jnp.arange(lo, hi, dtype=jnp.int32).reshape((-1,) + (1,) * ndim)
+
+
 def _edge_hits(key, send, lo: int, hi: int, drop_prob: float = 0.0, axis=None,
-               send_global=None):
+               send_global=None, impl: str = "threefry"):
     """[B, N_send_global, N_recv_local] 0/1 delivery indicators, self-edges
     removed.  Delays are sampled receiver-side (each edge's delay is consumed
     by exactly one shard, so per-shard independent draws are exact).
-    ``send_global`` lets callers reuse an already-gathered sender mask."""
+    ``send_global`` lets callers reuse an already-gathered sender mask;
+    ``impl`` selects the per-edge bit source (SimConfig.edge_sampler)."""
     n_loc = send.shape[0]
     send_g = _gather(send, axis) if send_global is None else send_global
     n_glob = send_g.shape[0]
     k = _shard_key(key, axis)
-    d = sample_edge_delays(k, (n_glob, n_loc), lo, hi)
+    d = sample_edge_delays(k, (n_glob, n_loc), lo, hi, impl)
     notself = (jnp.arange(n_glob)[:, None] != _global_ids(n_loc, axis)[None, :])
     mask = send_g.astype(jnp.int32)[:, None] * notself.astype(jnp.int32)
     if drop_prob > 0.0:
@@ -69,7 +84,7 @@ def _edge_hits(key, send, lo: int, hi: int, drop_prob: float = 0.0, axis=None,
             jax.random.fold_in(k, 0x0D0D), 1.0 - drop_prob, (n_glob, n_loc)
         )
         mask = mask * keep.astype(jnp.int32)
-    return jnp.stack([(d == lo + b).astype(jnp.int32) * mask for b in range(hi - lo)])
+    return (d[None] == _bucket_iota(lo, hi, d.ndim)).astype(jnp.int32) * mask[None]
 
 
 # --------------------------------------------------------------------------- #
@@ -77,20 +92,23 @@ def _edge_hits(key, send, lo: int, hi: int, drop_prob: float = 0.0, axis=None,
 # --------------------------------------------------------------------------- #
 
 
-def bcast_counts_dense(key, send, lo, hi, drop_prob=0.0, axis=None):
+def bcast_counts_dense(key, send, lo, hi, drop_prob=0.0, axis=None,
+                       impl="threefry"):
     """Broadcast → per-receiver arrival counts.  Returns [B, N_loc]."""
-    return _edge_hits(key, send, lo, hi, drop_prob, axis).sum(1)
+    return _edge_hits(key, send, lo, hi, drop_prob, axis, impl=impl).sum(1)
 
 
-def bcast_value_max_dense(key, send, value, lo, hi, drop_prob=0.0, axis=None):
+def bcast_value_max_dense(key, send, value, lo, hi, drop_prob=0.0, axis=None,
+                          impl="threefry"):
     """Broadcast of a per-sender value (>0; 0 = empty), max-combined at the
     receiver.  Returns [B, N_loc]."""
-    hits = _edge_hits(key, send, lo, hi, drop_prob, axis)
+    hits = _edge_hits(key, send, lo, hi, drop_prob, axis, impl=impl)
     value_g = _gather(value, axis)
     return (hits * value_g.astype(jnp.int32)[None, :, None]).max(1)
 
 
-def bcast_slots_dense(key, slot_mat, lo, hi, drop_prob=0.0, axis=None):
+def bcast_slots_dense(key, slot_mat, lo, hi, drop_prob=0.0, axis=None,
+                      impl="threefry"):
     """Slot-keyed broadcast (e.g. PBFT messages carrying seq no n): sender i
     broadcasts ``slot_mat[i, s]`` copies per slot (int counts; >1 only for
     Byzantine vote flooding).  Returns arrival counts per (receiver, slot):
@@ -102,12 +120,14 @@ def bcast_slots_dense(key, slot_mat, lo, hi, drop_prob=0.0, axis=None):
     slot_g = _gather(slot_mat.astype(jnp.int32), axis)
     send = slot_mat.max(axis=1) > 0
     hits = _edge_hits(
-        key, send, lo, hi, drop_prob, axis, send_global=slot_g.max(axis=1) > 0
+        key, send, lo, hi, drop_prob, axis, send_global=slot_g.max(axis=1) > 0,
+        impl=impl,
     )  # [B, N_glob, N_loc] 0/1
     return jnp.einsum("bij,is->bjs", hits, slot_g)
 
 
-def bcast_window_value_max_dense(key, value_mat, lo, hi, drop_prob=0.0, axis=None):
+def bcast_window_value_max_dense(key, value_mat, lo, hi, drop_prob=0.0, axis=None,
+                                 impl="threefry"):
     """Per-window value broadcast (PBFT PRE_PREPARE carrying the slot id):
     sender i announces ``value_mat[i, w]`` (>0; 0 = empty) for window w; the
     receiver max-combines per window.  Returns [B, N_loc, W].
@@ -117,7 +137,8 @@ def bcast_window_value_max_dense(key, value_mat, lo, hi, drop_prob=0.0, axis=Non
     value_g = _gather(value_mat.astype(jnp.int32), axis)  # [N_glob, W]
     send = value_mat.max(axis=1) > 0
     hits = _edge_hits(
-        key, send, lo, hi, drop_prob, axis, send_global=value_g.max(axis=1) > 0
+        key, send, lo, hi, drop_prob, axis, send_global=value_g.max(axis=1) > 0,
+        impl=impl,
     )  # [B, N_glob, N_loc] 0/1
     return (hits[:, :, :, None] * value_g[None, :, None, :]).max(axis=1)
 
@@ -144,11 +165,12 @@ def bcast_window_value_max_stat(key, value_mat, probs: np.ndarray, drop_prob=0.0
         )
         recv = recv & keep
     val = recv.astype(jnp.int32) * vmax[None, :]
-    return jnp.stack([(d == b).astype(jnp.int32) * val for b in range(nb)])
+    return (d[None] == _bucket_iota(0, nb, d.ndim)).astype(jnp.int32) * val[None]
 
 
 def roundtrip_reply_counts_dense(
-    key, send, lo, hi, drop_prob=0.0, peer_mask=None, axis=None
+    key, send, lo, hi, drop_prob=0.0, peer_mask=None, axis=None,
+    impl="threefry",
 ):
     """Short-circuited request/reply round trip: sender i broadcasts, every
     peer replies unconditionally and instantly, the reply travels back with an
@@ -166,8 +188,8 @@ def roundtrip_reply_counts_dense(
     peers_g = _gather(peers, axis)
     n_glob = peers_g.shape[0]
     k = _shard_key(key, axis)
-    d1 = sample_edge_delays(jax.random.fold_in(k, 1), (n_loc, n_glob), lo, hi)
-    d2 = sample_edge_delays(jax.random.fold_in(k, 2), (n_loc, n_glob), lo, hi)
+    d1 = sample_edge_delays(jax.random.fold_in(k, 1), (n_loc, n_glob), lo, hi, impl)
+    d2 = sample_edge_delays(jax.random.fold_in(k, 2), (n_loc, n_glob), lo, hi, impl)
     total = d1 + d2  # delay until the reply reaches the sender
     notself = (_global_ids(n_loc, axis)[:, None] != jnp.arange(n_glob)[None, :])
     mask = (
@@ -183,12 +205,16 @@ def roundtrip_reply_counts_dense(
         mask = mask * keep.astype(jnp.int32)
     lo2 = 2 * lo
     nb = 2 * (hi - lo) - 1
-    return jnp.stack(
-        [((total == lo2 + b).astype(jnp.int32) * mask).sum(1) for b in range(nb)]
-    )
+    # one broadcast compare + reduction instead of nb masked passes over the
+    # [N_loc, N_glob] edge tensor (integer sums — bit-equal either way)
+    return (
+        (total[None] == _bucket_iota(lo2, lo2 + nb, total.ndim)).astype(jnp.int32)
+        * mask[None]
+    ).sum(2)
 
 
-def unicast_reply_counts_dense(key, reply, lo, hi, drop_prob=0.0, axis=None):
+def unicast_reply_counts_dense(key, reply, lo, hi, drop_prob=0.0, axis=None,
+                               impl="threefry"):
     """Route per-(replier, requester) reply counts back to each requester.
     ``reply[r, c]`` = number of (identical, count-consumed) replies local
     node r sends global node c this tick.  Returns [B, N_loc] indexed by
@@ -196,7 +222,7 @@ def unicast_reply_counts_dense(key, reply, lo, hi, drop_prob=0.0, axis=None):
     shards (the repliers), which is a ``psum`` over the axis."""
     n_loc, n_glob = reply.shape
     k = _shard_key(key, axis)
-    d = sample_edge_delays(k, (n_loc, n_glob), lo, hi)
+    d = sample_edge_delays(k, (n_loc, n_glob), lo, hi, impl)
     notself = (_global_ids(n_loc, axis)[:, None] != jnp.arange(n_glob)[None, :])
     mask = notself.astype(jnp.int32)
     if drop_prob > 0.0:
@@ -205,7 +231,9 @@ def unicast_reply_counts_dense(key, reply, lo, hi, drop_prob=0.0, axis=None):
         )
         mask = mask * keep.astype(jnp.int32)
     r = reply.astype(jnp.int32) * mask
-    out_g = jnp.stack([(r * (d == lo + b)).sum(0) for b in range(hi - lo)])  # [B, N_glob]
+    out_g = (
+        r[None] * (d[None] == _bucket_iota(lo, hi, d.ndim)).astype(jnp.int32)
+    ).sum(1)  # [B, N_glob]
     if axis is None:
         return out_g
     out_g = lax.psum(out_g, axis)
@@ -214,13 +242,14 @@ def unicast_reply_counts_dense(key, reply, lo, hi, drop_prob=0.0, axis=None):
     return lax.dynamic_slice_in_dim(out_g, start, n_loc, axis=1)
 
 
-def bcast_matrix_dense(key, send, value, lo, hi, drop_prob=0.0, axis=None):
+def bcast_matrix_dense(key, send, value, lo, hi, drop_prob=0.0, axis=None,
+                       impl="threefry"):
     """Identity-preserving broadcast for request channels whose handling
     depends on receiver state at arrival (Raft VOTE_REQ, Paxos REQUEST_*).
     ``value`` (>0 per sender; 0 = empty) lands at ``[b, receiver_local,
     sender_global]``.  Returns [B, N_loc, N_glob] (max-combined into a matrix
     ring)."""
-    hits = _edge_hits(key, send, lo, hi, drop_prob, axis)  # [B, glob, loc]
+    hits = _edge_hits(key, send, lo, hi, drop_prob, axis, impl=impl)  # [B, glob, loc]
     value_g = _gather(value, axis)
     return jnp.swapaxes(hits * value_g.astype(jnp.int32)[None, :, None], 1, 2)
 
@@ -248,11 +277,11 @@ def bcast_counts_stat(key, n_senders, is_sender, probs: np.ndarray, drop_prob=0.
     return sample_bucket_counts(k, m, probs, mode)
 
 
-def bcast_slots_stat(key, slot_mat, probs: np.ndarray, drop_prob=0.0, axis=None,
-                     mode="exact"):
-    """Stat version of bcast_slots_dense: receiver j hears, per slot s,
-    from ``(Σ_i slot_mat[i,s]) - slot_mat[j,s]`` senders; arrival buckets are
-    multinomial per (receiver, slot).  Returns [B, N_loc, S]."""
+def _slots_stat_m(key, slot_mat, drop_prob, axis, mode):
+    """(shard key, per-(receiver, slot) sender counts) of the stat slot
+    broadcast — the shared front half of :func:`bcast_slots_stat` and the
+    fused :func:`push_bcast_slots_stat` (identical keys and arithmetic, so
+    the two are bit-equal)."""
     k = _shard_key(key, axis)
     sm = slot_mat.astype(jnp.int32)
     totals = sm.sum(axis=0)
@@ -263,6 +292,15 @@ def bcast_slots_stat(key, slot_mat, probs: np.ndarray, drop_prob=0.0, axis=None,
         m = jnp.round(
             binom(jax.random.fold_in(k, 0x0D12), m, 1.0 - drop_prob, mode)
         ).astype(jnp.int32)
+    return k, m
+
+
+def bcast_slots_stat(key, slot_mat, probs: np.ndarray, drop_prob=0.0, axis=None,
+                     mode="exact"):
+    """Stat version of bcast_slots_dense: receiver j hears, per slot s,
+    from ``(Σ_i slot_mat[i,s]) - slot_mat[j,s]`` senders; arrival buckets are
+    multinomial per (receiver, slot).  Returns [B, N_loc, S]."""
+    k, m = _slots_stat_m(key, slot_mat, drop_prob, axis, mode)
     return sample_bucket_counts(k, m, probs, mode)
 
 
@@ -285,7 +323,24 @@ def bcast_value_max_stat(key, value, probs: np.ndarray, drop_prob=0.0, axis=None
         sent = sent * keep.astype(jnp.int32)
     # a node that announced the (same, max) value already applied it locally;
     # re-delivery to it is a harmless no-op, matching max-combine semantics
-    return jnp.stack([(d == b).astype(jnp.int32) * sent * vmax for b in range(nb)])
+    return (
+        (d[None] == _bucket_iota(0, nb, d.ndim)).astype(jnp.int32)
+        * (sent * vmax)[None]
+    )
+
+
+def _roundtrip_stat_m(key, send, n_peers, drop_prob, axis, mode):
+    """(shard key, per-sender reply counts) of the stat round trip — the
+    shared front half of :func:`roundtrip_reply_counts_stat` and the fused
+    :func:`push_roundtrip_reply_counts_stat`."""
+    k = _shard_key(key, axis)
+    m = send.astype(jnp.int32) * jnp.asarray(n_peers, jnp.int32)
+    if drop_prob > 0.0:
+        p_keep = (1.0 - drop_prob) ** 2
+        m = jnp.round(
+            binom(jax.random.fold_in(k, 0x0D11), m, p_keep, mode)
+        ).astype(jnp.int32)
+    return k, m
 
 
 def roundtrip_reply_counts_stat(
@@ -294,14 +349,74 @@ def roundtrip_reply_counts_stat(
     """Stat version of roundtrip_reply_counts_dense: each active sender gets
     ``n_peers`` (global count, per local sender) replies multinomially spread
     over the round-trip distribution.  Returns [B2, N_loc]."""
-    k = _shard_key(key, axis)
-    m = send.astype(jnp.int32) * jnp.asarray(n_peers, jnp.int32)
-    if drop_prob > 0.0:
-        p_keep = (1.0 - drop_prob) ** 2
-        m = jnp.round(
-            binom(jax.random.fold_in(k, 0x0D11), m, p_keep, mode)
-        ).astype(jnp.int32)
+    k, m = _roundtrip_stat_m(key, send, n_peers, drop_prob, axis, mode)
     return sample_bucket_counts(k, m, rt_probs, mode)
+
+
+# --------------------------------------------------------------------------- #
+# fused sample-and-push (stat chains combined straight into the rings)        #
+# --------------------------------------------------------------------------- #
+
+
+def push_bucket_counts(buf, t, push_lo: int, key, m, probs: np.ndarray,
+                       mode: str = "exact", expand=None):
+    """Sample ``Multinomial(m, probs)`` bucket counts and combine each bucket
+    into its ring slice AS IT IS PRODUCED — the cost-analysis-driven fusion
+    of the tick engine's delivery math (ISSUE 13 / KNOWN_ISSUES #5: the tick
+    wall is sampler/delivery compute).  Equivalent unfused form::
+
+        ring_push_add(buf, t, push_lo, expand*(sample_bucket_counts(...)))
+
+    materializes the stacked ``[B, ...]`` tensor between two unfusable op
+    islands (the chain's stack and the push's unstack); here bucket ``b``'s
+    ~5 elementwise chain ops fuse directly into its dynamic-update-slice,
+    so XLA never round-trips the intermediate through memory.  Bit-equal to
+    the unfused form: same keys (delay.bucket_count_chain yields exactly
+    what sample_bucket_counts stacks), same integer adds, same bucket
+    order.  ``expand`` (optional) maps a bucket's int32 counts to its ring
+    contribution (e.g. broadcasting per-window activity masks).
+
+    When the pallas ring kernel is armed (``BLOCKSIM_RING_KERNEL``,
+    ops/ring_kernel.py) the unfused compose runs instead, so the kernel
+    keeps seeing whole stacked contributions."""
+    from blockchain_simulator_tpu.ops import ring_kernel
+    from blockchain_simulator_tpu.ops.ring import ring_push_add
+
+    if ring_kernel.enabled():
+        cnt = sample_bucket_counts(key, m, probs, mode)
+        contrib = (
+            cnt if expand is None
+            else jnp.stack([expand(cnt[b]) for b in range(cnt.shape[0])])
+        )
+        return ring_push_add(buf, t, push_lo, contrib)
+    d = buf.shape[0]
+    for b, c in enumerate(bucket_count_chain(key, m, probs, mode)):
+        cb = c.astype(jnp.int32)
+        contrib = cb if expand is None else expand(cb)
+        idx = jnp.mod(t + push_lo + b, d)
+        cur = lax.dynamic_index_in_dim(buf, idx, 0, keepdims=False)
+        buf = lax.dynamic_update_index_in_dim(buf, cur + contrib, idx, 0)
+    return buf
+
+
+def push_bcast_slots_stat(buf, t, push_lo: int, key, slot_mat,
+                          probs: np.ndarray, drop_prob=0.0, axis=None,
+                          mode="exact"):
+    """Fused ``ring_push_add(buf, t, push_lo, bcast_slots_stat(...))`` —
+    bit-equal to the compose (shared key/count helper), without the stacked
+    [B, N_loc, S] intermediate."""
+    k, m = _slots_stat_m(key, slot_mat, drop_prob, axis, mode)
+    return push_bucket_counts(buf, t, push_lo, k, m, probs, mode)
+
+
+def push_roundtrip_reply_counts_stat(buf, t, push_lo: int, key, send, n_peers,
+                                     rt_probs: np.ndarray, drop_prob=0.0,
+                                     axis=None, mode="exact", expand=None):
+    """Fused ``ring_push_add(buf, t, push_lo, expand*(roundtrip_reply_counts_
+    stat(...)))`` — bit-equal to the compose, without the stacked [B2, N_loc]
+    (or expanded [B2, N_loc, W]) intermediate."""
+    k, m = _roundtrip_stat_m(key, send, n_peers, drop_prob, axis, mode)
+    return push_bucket_counts(buf, t, push_lo, k, m, rt_probs, mode, expand)
 
 
 # --------------------------------------------------------------------------- #
@@ -310,7 +425,7 @@ def roundtrip_reply_counts_stat(
 
 
 def gossip_fwd(key, fwd_vals, nbrs_loc, n_glob, lo, hi, drop_prob=0.0, axis=None,
-               fold=0x0D22):
+               fold=0x0D22, impl="threefry"):
     """TTL-flood forwarding: ``fwd_vals [N_loc, P]`` (>0 TTL-encoded values
     held by local rows; P = any per-value lane — Paxos proposers, PBFT
     windows) → ``[B, N_loc, P]`` scatter-max contributions at each sender's
@@ -321,7 +436,7 @@ def gossip_fwd(key, fwd_vals, nbrs_loc, n_glob, lo, hi, drop_prob=0.0, axis=None
     n_loc, p = fwd_vals.shape
     deg = nbrs_loc.shape[1]
     k = _shard_key(key, axis)
-    d = sample_edge_delays(k, (n_loc, deg, p), lo, hi)
+    d = sample_edge_delays(k, (n_loc, deg, p), lo, hi, impl)
     vals = jnp.broadcast_to(fwd_vals[:, None, :], (n_loc, deg, p))
     if drop_prob > 0.0:
         keep = jax.random.bernoulli(

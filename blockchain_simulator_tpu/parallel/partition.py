@@ -226,6 +226,24 @@ def sweep_axis_size(mesh) -> int:
     return int(dict(mesh.shape).get(SWEEP_AXIS, 0))
 
 
+def seq_map(fn):
+    """``batched(*operands) -> finals`` running the batch SEQUENTIALLY
+    through the UNVMAPPED ``fn`` via ``lax.map`` — the scatter-free batch
+    body (KNOWN_ISSUES #0i): per-lane dynamic-update-slice pushes stay
+    plain DUS instead of vmap's DUS→scatter lowering, which XLA:CPU
+    serializes, and each lane is a batch-1-shaped program (the only shape
+    ever observed to work on the TPU, issue #2).  Shared by the
+    mesh-partitioned sweep's per-device body (sweep.mesh_dyn_batched_fn)
+    and the single-device multi-seed tick executable
+    (sweep.multi_seed_fn) so the two arms stay one mechanism."""
+    import jax
+
+    def batched(*operands):
+        return jax.lax.map(lambda args: fn(*args), operands)
+
+    return batched
+
+
 def pad_points(points, lanes: int):
     """Pad ``points`` (any list) to a multiple of ``lanes`` by repeating the
     last element — the uneven-grid lanes of a mesh dispatch (a padded lane

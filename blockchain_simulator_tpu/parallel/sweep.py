@@ -144,10 +144,9 @@ def mesh_dyn_batched_fn(cfg: SimConfig, mesh):
             out_shardings=partition.batched_out_shardings(cfg, mesh, outs),
         )
 
-    def body(keys, nc, nb):
-        # per-device: local lanes run SEQUENTIALLY through the unvmapped
-        # program (lax.map = scan of the solo body, constant program size)
-        return jax.lax.map(lambda args: fn(*args), (keys, nc, nb))
+    # per-device: local lanes run SEQUENTIALLY through the unvmapped
+    # program (lax.map = scan of the solo body, constant program size)
+    body = partition.seq_map(fn)
 
     from jax.sharding import PartitionSpec as P
 
@@ -155,6 +154,40 @@ def mesh_dyn_batched_fn(cfg: SimConfig, mesh):
     return partition.partition(
         body, mesh, in_specs=(lane, lane, lane), out_specs=lane
     )
+
+
+@aotcache.cached_factory("multi-seed-tick")
+def multi_seed_fn(cfg: SimConfig, n_seeds: int):
+    """THE single-device multi-seed Monte Carlo executable:
+    ``batched(keys[B], n_crashed[B], n_byzantine[B]) -> finals`` running B
+    seeds of one fault structure as ONE dispatch of a ``lax.map`` over the
+    UNVMAPPED dyn program (partition.seq_map — the per-device body of the
+    mesh sweep arm, without the mesh).
+
+    Why this beats the vmapped ``dyn_batched_fn`` on the tick path
+    (ISSUE 13 / ROADMAP item 4): every tick-engine channel push is a
+    dynamic-update-slice on a scan-carried ring, and vmap over the batch
+    axis lowers each one to XLA generic scatter, which XLA:CPU serializes
+    (KNOWN_ISSUES #0b/#0i — the mesh bench measured the scatter-free body
+    ~2.3x per lane at 10k nodes on the round path; the tick engine pushes
+    3-4 rings per tick, so its gap is wider, see ARTIFACT_tick_bench.json).
+    The ``lax.map`` body keeps every push a plain DUS, each lane is the
+    batch-1-shaped program (the only shape ever observed to survive the
+    TPU batch>=2 hazard, issue #2), and the whole batch costs one Python
+    dispatch + one executable.
+
+    ``cfg`` must already be canonical (models/base.canonical_fault_cfg):
+    one registry entry per (fault structure, B) — seeds and fault counts
+    ride the mapped operands, never the trace (divergence twins pin this,
+    lint/graph/programs.py ``multi_seed.*``).  Rows are bit-equal per seed
+    to sequential solo runs of ``jit(make_dyn_sim_fn(cfg))`` under the
+    exact sampler (tests/test_ztick.py); the "normal" CLT float caveat in
+    the module docstring applies unchanged."""
+    # n_seeds only keys the registry entry (jit specializes on the operand
+    # batch shape either way; keying it keeps hit/miss stats per-(cfg, B)
+    # truthful — the one-executable pins count misses around dispatches)
+    del n_seeds
+    return jax.jit(partition.seq_map(make_dyn_sim_fn(cfg)))
 
 
 def run_seed_sweep(cfg: SimConfig, seeds, mesh=None):
@@ -194,10 +227,14 @@ def _dyn_operands(cfg: SimConfig, fc) -> tuple[int, int]:
 
 
 def _dispatch_dyn_points(canon: SimConfig, points, record: bool = True,
-                         n_out: int | None = None, mesh=None):
-    """ONE un-journaled vmapped dispatch of a same-structure point list —
+                         n_out: int | None = None, mesh=None,
+                         multi_seed: bool = False):
+    """ONE un-journaled batched dispatch of a same-structure point list —
     the body :func:`run_dyn_points` either calls directly (no journal) or
-    wraps in chunked, supervised, durable execution."""
+    wraps in chunked, supervised, durable execution.  ``multi_seed``
+    selects the scatter-free ``lax.map`` program (:func:`multi_seed_fn`)
+    over the vmapped one on the single-device path; a mesh dispatch
+    already maps sequentially per device, so the flag is a no-op there."""
     points = list(points)
     # the batched-dispatch chaos point: the drills inject raise/hang/slow
     # here — the exact exception path a real backend fault takes through
@@ -208,6 +245,8 @@ def _dispatch_dyn_points(canon: SimConfig, points, record: bool = True,
         lanes = max(partition.sweep_axis_size(mesh), 1)
         dispatch_points, _ = partition.pad_points(points, lanes)
         batched = mesh_dyn_batched_fn(canon, mesh)
+    elif multi_seed:
+        batched = multi_seed_fn(canon, len(points))
     else:
         batched = dyn_batched_fn(canon)
     keys = jax.vmap(jax.random.key)(
@@ -231,7 +270,7 @@ def _dispatch_dyn_points(canon: SimConfig, points, record: bool = True,
 
 
 def _run_chunk(canon, tile, record, n_out, mesh, supervise, journal, key,
-               index):
+               index, multi_seed=False):
     """Compute ONE chunk, optionally under the supervisor's deadline →
     retry → degrade state machine (parallel/journal.py).  The
     ``sweep.chunk`` chaos point fires once per ATTEMPT with the arm in
@@ -242,7 +281,8 @@ def _run_chunk(canon, tile, record, n_out, mesh, supervise, journal, key,
         inject.chaos_point("sweep.chunk", key=key, index=index,
                            n=len(tile), arm="primary",
                            mesh=mesh is not None)
-        return _dispatch_dyn_points(canon, tile, record, n_out, mesh)
+        return _dispatch_dyn_points(canon, tile, record, n_out, mesh,
+                                    multi_seed)
 
     if supervise is None:
         return primary()
@@ -287,7 +327,8 @@ def _run_chunk(canon, tile, record, n_out, mesh, supervise, journal, key,
 
 def run_dyn_points(canon: SimConfig, points, record: bool = True,
                    n_out: int | None = None, mesh=None, journal=None,
-                   chunk_size: int | None = None, supervise=None):
+                   chunk_size: int | None = None, supervise=None,
+                   multi_seed: bool = False):
     """THE group-dispatch primitive: one vmapped executable over an
     arbitrary list of same-structure ``(cfg, seed)`` points.
 
@@ -334,10 +375,19 @@ def run_dyn_points(canon: SimConfig, points, record: bool = True,
     the scenario server's batched flushes route through this function
     and its admission is already health-gated — raising per flush would
     only be swallowed into an un-gated degrade-to-solo
-    (serve/dispatch.run_batch's typed-error wrapper)."""
+    (serve/dispatch.run_batch's typed-error wrapper).
+
+    ``multi_seed=True`` dispatches single-device batches through the
+    scatter-free ``lax.map`` executable (:func:`multi_seed_fn`) instead of
+    the vmapped one — the tick-path throughput arm (ISSUE 13; measured in
+    ARTIFACT_tick_bench.json), rows bit-equal under the exact sampler.
+    The default stays the vmapped program so existing registry
+    trajectories and pins are untouched; ``runner.run_multi_seed`` and
+    the sweeps' ``multi_seed=`` kwarg are the opt-ins."""
     points = list(points)
     if journal is None and supervise is None:
-        return _dispatch_dyn_points(canon, points, record, n_out, mesh)
+        return _dispatch_dyn_points(canon, points, record, n_out, mesh,
+                                    multi_seed)
     if not points:
         return []
     if chunk_size is None or n_out is not None:
@@ -363,7 +413,7 @@ def run_dyn_points(canon: SimConfig, points, record: bool = True,
         # arm's rows (journaled below) reach runs.jsonl — an abandoned
         # slow attempt finishing late must not double-record its points
         rows = _run_chunk(canon, tile, False, t_out, mesh, supervise,
-                          journal, key, index)
+                          journal, key, index, multi_seed)
         # durable BEFORE the next chunk dispatches — the recompute-at-
         # most-one contract the kill -9 drill pins
         if journal is not None:
@@ -404,7 +454,7 @@ def dyn_chunk_keys(cfg: SimConfig, fault_configs, seeds, mesh=None):
 
 
 def _run_dyn_group(cfg: SimConfig, canon: SimConfig, fcs, seeds, mesh=None,
-                   journal=None, supervise=None):
+                   journal=None, supervise=None, multi_seed=False):
     """One compiled program for every (fault config, seed) point of a
     same-structure group; returns {fc: [metrics per seed]} with rows
     bit-equal to ``run_seed_sweep(cfg.with_(faults=fc), seeds)``.
@@ -416,7 +466,7 @@ def _run_dyn_group(cfg: SimConfig, canon: SimConfig, fcs, seeds, mesh=None,
     tiled = journal is not None or supervise is not None
     rows = run_dyn_points(canon, points, mesh=mesh, journal=journal,
                           chunk_size=len(seeds) if tiled else None,
-                          supervise=supervise)
+                          supervise=supervise, multi_seed=multi_seed)
     n_s = len(seeds)
     return {
         fc: rows[i * n_s:(i + 1) * n_s] for i, fc in enumerate(fcs)
@@ -424,7 +474,7 @@ def _run_dyn_group(cfg: SimConfig, canon: SimConfig, fcs, seeds, mesh=None,
 
 
 def run_fault_sweep(cfg: SimConfig, fault_configs, seeds, mesh=None,
-                    journal=None, supervise=None):
+                    journal=None, supervise=None, multi_seed=False):
     """BASELINE config 4: sweep fault configs with seeds vmapped inside.
     Returns {fault_config: [metrics per seed]}.
 
@@ -456,7 +506,13 @@ def run_fault_sweep(cfg: SimConfig, fault_configs, seeds, mesh=None,
     ``wedged`` verdict in the rolling health log
     ($BLOCKSIM_HEALTH_JSONL) fails fast with the typed
     ``utils.health.BackendWedgedError`` instead of hanging on backend
-    init — the bench.py ladder rule, now on the sweep tier."""
+    init — the bench.py ladder rule, now on the sweep tier.
+
+    ``multi_seed=True`` routes every single-device dynamic-operand group
+    through the scatter-free ``lax.map`` executable
+    (:func:`multi_seed_fn`) — seed-replicated sweep tiles collapse into
+    one dispatch of the tick-path throughput arm (ISSUE 13), rows
+    bit-equal to the default vmapped dispatch under the exact sampler."""
     from blockchain_simulator_tpu.utils import health
 
     health.require_not_wedged()
@@ -476,7 +532,8 @@ def run_fault_sweep(cfg: SimConfig, fault_configs, seeds, mesh=None,
     done: dict = {}
     for canon, fcs in groups.items():
         done.update(_run_dyn_group(cfg, canon, fcs, seeds, mesh=mesh,
-                                   journal=journal, supervise=supervise))
+                                   journal=journal, supervise=supervise,
+                                   multi_seed=multi_seed))
     results = {}
     for fc in fault_configs:
         if order[fc] is None:
@@ -487,7 +544,8 @@ def run_fault_sweep(cfg: SimConfig, fault_configs, seeds, mesh=None,
 
 
 def run_byzantine_sweep(cfg: SimConfig, f_values=None, seeds=(0,), forge=True,
-                        mesh=None, journal=None, supervise=None):
+                        mesh=None, journal=None, supervise=None,
+                        multi_seed=False):
     """BASELINE config 4 end-to-end: sweep the Byzantine count f over
     ``f_values`` (default 0..(n-1)//3), seeds batched per f — the whole
     sweep is ONE vmapped executable over (f, seed) (dynamic fault operands;
@@ -516,7 +574,8 @@ def run_byzantine_sweep(cfg: SimConfig, f_values=None, seeds=(0,), forge=True,
     ]
     # dedup: repeated f values share one fault config (and one batch row set)
     res = run_fault_sweep(cfg, list(dict.fromkeys(fcs)), seeds, mesh=mesh,
-                          journal=journal, supervise=supervise)
+                          journal=journal, supervise=supervise,
+                          multi_seed=multi_seed)
     out = []
     for f, fc in zip(f_values, fcs):
         for seed, m in zip(seeds, res[fc]):

@@ -328,6 +328,31 @@ def final_state(cfg: SimConfig, seed: int | None = None):
     return jax.block_until_ready(sim(key))
 
 
+def run_multi_seed(cfg: SimConfig, seeds, record: bool = True):
+    """Multi-seed Monte Carlo: run ``len(seeds)`` seeds of one config as ONE
+    dispatch of the scatter-free ``lax.map`` executable
+    (parallel/sweep.multi_seed_fn — the tick-path throughput arm of
+    ISSUE 13 / ROADMAP item 4).  Returns one metrics dict per seed, in
+    order, each bit-equal (exact sampler; parallel/sweep.py caveat for the
+    "normal" CLT float path) to ``run_simulation(cfg, seed=s)``.
+
+    Compared to looping :func:`run_simulation`: one executable per
+    (fault structure, seed count) — seed values ride the key operand, so a
+    fresh seed set never recompiles — and one Python dispatch + sync for
+    the whole batch.  Compared to the vmapped ``run_seed_sweep``: the
+    unvmapped ``lax.map`` body keeps the tick engine's ring pushes plain
+    dynamic-update-slices instead of vmap's DUS→scatter lowering, which
+    XLA:CPU serializes (KNOWN_ISSUES #0i; measured on the tick path in
+    ARTIFACT_tick_bench.json).  Mixed (the one un-batchable protocol)
+    raises the typed :class:`UnbatchableConfigError`."""
+    from blockchain_simulator_tpu.parallel import sweep
+
+    canon = base_model.canonical_fault_cfg(cfg)
+    points = [(cfg, int(s)) for s in seeds]
+    return sweep.run_dyn_points(canon, points, record=record,
+                                multi_seed=True)
+
+
 @aotcache.cached_factory("segment")
 def make_segment_fn(cfg: SimConfig, n_ticks: int):
     """Jitted ``seg(key, state, bufs, t0) -> (state, bufs)`` advancing the

@@ -122,6 +122,24 @@ class SimConfig:
     # "auto"   — "normal" when n >= 4096 (where the error is negligible and
     #            the tick loop is sampler-bound), else "exact".
     stat_sampler: str = "auto"
+    # Per-edge integer delay sampler for the *edge* paths (ops/delay.py
+    # sample_edge_delays — dense delivery, gossip forwarding):
+    # "threefry" — jax.random.randint on the caller's threefry key: the
+    #              historical stream every bit-pinned edge-path test rides.
+    # "rbg"      — the same exact-uniform integer map fed by XLA's
+    #              RngBitGenerator (the ops/delay._fast_normal trick): far
+    #              cheaper bit generation on XLA:CPU, pure integer ops —
+    #              bit-stable across unbatched compilations (jit, lax.map
+    #              lanes, mesh bodies), though NOT under vmap batching
+    #              (RngBitGenerator is not batch-invariant; same caveat
+    #              class as the "normal" stat mode — see ops/delay.py).
+    #              Power-of-two spans bit-slice each word into two
+    #              exactly-uniform 16-bit draws.  A DIFFERENT stream than
+    #              "threefry" (same distribution), so flipping the toggle
+    #              moves seed-pinned trajectories.
+    # "auto"     — "rbg" when n >= 4096 (edge tensors are O(N^2): the
+    #              sampler dominates the tick), else "threefry".
+    edge_sampler: str = "threefry"
     # Stepping granularity of the simulation loop:
     # "tick"  — the general engine: one scan step per 1 ms tick (always valid).
     # "round" — PBFT fast path: one scan step per block interval
@@ -230,6 +248,8 @@ class SimConfig:
             raise ValueError(f"unknown fidelity {self.fidelity!r}")
         if self.stat_sampler not in ("exact", "normal", "auto"):
             raise ValueError(f"unknown stat_sampler {self.stat_sampler!r}")
+        if self.edge_sampler not in ("threefry", "rbg", "auto"):
+            raise ValueError(f"unknown edge_sampler {self.edge_sampler!r}")
         if self.schedule not in ("tick", "round", "auto"):
             raise ValueError(f"unknown schedule {self.schedule!r}")
         if self.quorum_rule not in ("n2", "2f1"):
@@ -309,6 +329,13 @@ class SimConfig:
         if self.stat_sampler == "auto":
             return "normal" if self.n >= 4096 else "exact"
         return self.stat_sampler
+
+    @property
+    def eff_edge_sampler(self) -> str:
+        """Resolved edge_sampler ('auto' -> by cluster size)."""
+        if self.edge_sampler == "auto":
+            return "rbg" if self.n >= 4096 else "threefry"
+        return self.edge_sampler
 
     @property
     def ticks(self) -> int:

@@ -140,6 +140,162 @@ def test_drop_prob_thins_traffic():
     assert int(c_half.sum()) < int(c_full.sum())
 
 
+# --- fast samplers (ISSUE 13: rbg edge sampler, hoisted exact chain) -------
+
+
+def test_rbg_edge_delays_in_range_and_uniform():
+    # general span (3): remainder map over full rbg words
+    d = np.asarray(delay_ops.sample_edge_delays(
+        jax.random.key(0), (400, 400), 3, 6, impl="rbg"))
+    assert d.min() >= 3 and d.max() <= 5
+    frac = np.bincount(d.ravel() - 3, minlength=3) / d.size
+    np.testing.assert_allclose(frac, 1 / 3, atol=0.005)
+
+
+def test_rbg_edge_delays_pow2_span_bit_sliced_uniform():
+    # power-of-two span: 16-bit slices + mask — exactly uniform
+    d = np.asarray(delay_ops.sample_edge_delays(
+        jax.random.key(1), (401, 400), 0, 4, impl="rbg"))
+    assert d.min() >= 0 and d.max() <= 3
+    frac = np.bincount(d.ravel(), minlength=4) / d.size
+    np.testing.assert_allclose(frac, 0.25, atol=0.005)
+
+
+def test_rbg_edge_delays_bit_contract():
+    """The integer bit contract, scoped as documented: same key -> same
+    delays across differently-compiled UNBATCHED programs (eager, jit,
+    lax.map lanes — the multi-seed/mesh arm bodies).  vmap is explicitly
+    OUT of scope (RngBitGenerator is not batch-invariant under vmap; pins
+    that vmap must keep edge_sampler='threefry')."""
+    key = jax.random.key(7)
+    eager = np.asarray(delay_ops.sample_edge_delays(key, (13, 9), 3, 6, impl="rbg"))
+    jitted = np.asarray(jax.jit(
+        lambda k: delay_ops.sample_edge_delays(k, (13, 9), 3, 6, impl="rbg")
+    )(key))
+    np.testing.assert_array_equal(eager, jitted)
+    mapped = np.asarray(jax.lax.map(
+        lambda k: delay_ops.sample_edge_delays(k, (13, 9), 3, 6, impl="rbg"),
+        jnp.stack([key, key]),
+    ))
+    np.testing.assert_array_equal(mapped[0], eager)
+    np.testing.assert_array_equal(mapped[1], eager)
+
+
+def test_rbg_edge_delays_differ_from_threefry_stream():
+    key = jax.random.key(3)
+    a = np.asarray(delay_ops.sample_edge_delays(key, (64, 64), 3, 6))
+    b = np.asarray(delay_ops.sample_edge_delays(key, (64, 64), 3, 6, impl="rbg"))
+    assert (a != b).any()  # distinct streams, same distribution
+
+
+def test_rbg_edge_delays_rejects_unknown_impl():
+    with pytest.raises(ValueError):
+        delay_ops.sample_edge_delays(jax.random.key(0), (4,), 3, 6, impl="philox")
+
+
+def test_exact_chain_hoisted_keys_bit_equal_per_bucket_fold_in():
+    """The satellite pin: hoisting the exact chain's key derivation to one
+    vmapped fold_in pass is BIT-PRESERVING vs the historical per-bucket
+    scalar fold_in (chosen over jax.random.split exactly so every
+    seed-pinned exact-sampler trajectory survives the hoist)."""
+    probs = delay_ops.roundtrip_probs(3, 6)
+    key = jax.random.key(11)
+    n = jnp.array([3, 40, 1000], jnp.int32)
+    got = delay_ops.sample_bucket_counts(key, n, probs)
+    # the pre-hoist construction, replayed literally
+    nf = jnp.asarray(n, jnp.float32)
+    counts, remaining, p_left = [], nf, 1.0
+    for b, pb in enumerate(probs):
+        frac = float(min(max(pb / max(p_left, 1e-9), 0.0), 1.0))
+        if b == len(probs) - 1 or frac >= 1.0:
+            c = remaining
+        else:
+            c = jax.random.binomial(jax.random.fold_in(key, b), remaining, frac)
+        counts.append(c)
+        remaining = remaining - c
+        p_left -= pb
+    np.testing.assert_array_equal(
+        np.asarray(got), np.asarray(jnp.stack(counts).astype(jnp.int32)))
+
+
+@pytest.mark.parametrize("mode", ["exact", "normal"])
+def test_bucket_counts_moments(mode):
+    """Statistical moment pin for both chain modes: per-bucket mean matches
+    the multinomial n*p within 3 sigma of the sample mean, totals conserve."""
+    probs = delay_ops.uniform_probs(0, 3)
+    trials, n_each = 4000, 60
+    n = jnp.full((trials,), n_each, jnp.int32)
+    c = np.asarray(delay_ops.sample_bucket_counts(
+        jax.random.key(5), n, probs, mode=mode))
+    np.testing.assert_array_equal(c.sum(0), n_each)
+    p = 1 / 3
+    se = np.sqrt(n_each * p * (1 - p) / trials)
+    for b in range(3):
+        assert abs(c[b].mean() - n_each * p) < 4 * se, (mode, b, c[b].mean())
+
+
+def test_bucket_count_chain_yields_what_sample_stacks():
+    probs = delay_ops.roundtrip_probs(0, 3)
+    key = jax.random.key(9)
+    n = jnp.array([[7, 0], [100, 3]], jnp.int32)
+    stacked = delay_ops.sample_bucket_counts(key, n, probs)
+    chained = jnp.stack(
+        list(delay_ops.bucket_count_chain(key, n, probs))
+    ).astype(jnp.int32)
+    np.testing.assert_array_equal(np.asarray(stacked), np.asarray(chained))
+
+
+# --- fused sample-and-push (ops/delivery.py push_* family) -----------------
+
+
+def test_push_bucket_counts_bit_equal_unfused_compose():
+    probs = delay_ops.roundtrip_probs(3, 6)
+    key = jax.random.key(2)
+    m = jnp.array([40, 0, 7, 100], jnp.int32)
+    buf0 = jnp.arange(12 * 4, dtype=jnp.int32).reshape(12, 4)
+    fused = dv.push_bucket_counts(buf0, 3, 6, key, m, probs)
+    unfused = ring_push_add(
+        buf0, 3, 6, delay_ops.sample_bucket_counts(key, m, probs))
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(unfused))
+
+
+def test_push_bucket_counts_expand_matches_expanded_compose():
+    probs = delay_ops.uniform_probs(0, 3)
+    key = jax.random.key(4)
+    m = jnp.array([9, 30], jnp.int32)
+    mask = jnp.array([[1, 0, 1], [0, 1, 1]], jnp.int32)  # [N, W]
+    buf0 = jnp.zeros((8, 2, 3), jnp.int32)
+    fused = dv.push_bucket_counts(
+        buf0, 1, 2, key, m, probs, expand=lambda c: c[:, None] * mask)
+    cnt = delay_ops.sample_bucket_counts(key, m, probs)
+    unfused = ring_push_add(buf0, 1, 2, cnt[:, :, None] * mask[None])
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(unfused))
+
+
+def test_push_roundtrip_stat_bit_equal_compose():
+    rt_probs = delay_ops.roundtrip_probs(3, 6)
+    key = jax.random.key(6)
+    send = jnp.array([True, False, True, True])
+    buf0 = jnp.zeros((14, 4), jnp.int32)
+    fused = dv.push_roundtrip_reply_counts_stat(
+        buf0, 0, 6, key, send, 3, rt_probs)
+    unfused = ring_push_add(
+        buf0, 0, 6,
+        dv.roundtrip_reply_counts_stat(key, send, 3, rt_probs))
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(unfused))
+
+
+def test_push_bcast_slots_stat_bit_equal_compose():
+    probs = delay_ops.uniform_probs(3, 6)
+    key = jax.random.key(8)
+    slot_mat = jnp.zeros((6, 4), jnp.int32).at[2, 3].set(1).at[0, 1].set(2)
+    buf0 = jnp.zeros((9, 6, 4), jnp.int32)
+    fused = dv.push_bcast_slots_stat(buf0, 2, 3, key, slot_mat, probs)
+    unfused = ring_push_add(
+        buf0, 2, 3, dv.bcast_slots_stat(key, slot_mat, probs))
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(unfused))
+
+
 # --- pallas fused ring push (ops/ring_kernel.py) ---------------------------
 
 

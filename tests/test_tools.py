@@ -289,6 +289,30 @@ def test_bench_compare_gates_sweep_points_per_s(tmp_path):
     assert proc.returncode == 0, proc.stdout
 
 
+def test_bench_compare_gates_tick_rounds_per_s(tmp_path):
+    """The tick-bench smoke's throughput metric rides the default
+    higher-is-better gate (tools/tick_bench.py --quick emits it); the full
+    run's tick_bench_rounds_per_s series is a separate name so quick/full
+    scales never mix (the mesh_sweep_bench precedent)."""
+    runs = tmp_path / "runs.jsonl"
+
+    def write(metric, vals):
+        runs.write_text("".join(
+            json.dumps({"metric": metric, "value": v,
+                        "manifest": {"obs_schema": 1}}) + "\n"
+            for v in vals))
+
+    write("tick_rounds_per_s", [100.0, 20.0])  # 5x slower: gated
+    proc = _run([str(BENCH_COMPARE), _bench_artifact(tmp_path, 1, 100.0),
+                 "--runs", str(runs)])
+    assert proc.returncode == 1
+    assert "REGRESSION: tick_rounds_per_s" in proc.stdout
+    write("tick_rounds_per_s", [20.0, 100.0])  # faster ticks never trip
+    proc = _run([str(BENCH_COMPARE), _bench_artifact(tmp_path, 1, 100.0),
+                 "--runs", str(runs)])
+    assert proc.returncode == 0, proc.stdout
+
+
 def test_bench_compare_never_gates_p50_latency(tmp_path):
     """The median moves with the max_wait batching knob by design: charted
     only (UNGATED_SUFFIXES), in either direction."""
@@ -336,9 +360,11 @@ def test_lint_sh_chains_both_gates(tmp_path):
         # RESUME=0: the sweep resume drill SIGKILLs a real subprocess
         # pair — covered by tests/test_zjournal.py (in-process resume
         # pin) and the slow CLI test.
+        # TICK=0: the tick-bench smoke compiles three dispatch arms —
+        # covered by tests/test_ztick.py (bit-equality + executable pins).
         env={**os.environ, "BLOCKSIM_RUNS_JSONL": str(runs),
              "WARM_BENCH": "0", "GRAPH": "0", "SERVE": "0", "CHAOS": "0",
-             "MESH_SWEEP": "0", "FLEET": "0", "RESUME": "0"},
+             "MESH_SWEEP": "0", "FLEET": "0", "RESUME": "0", "TICK": "0"},
     )
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
     assert "jaxlint" in proc.stdout and "no regression" in proc.stdout
@@ -357,6 +383,8 @@ def test_lint_sh_chains_both_gates(tmp_path):
     assert '"${FLEET:-1}"' in script
     assert "tools/sweep_resume_drill.py --quick" in script
     assert '"${RESUME:-1}"' in script
+    assert "tools/tick_bench.py --quick" in script
+    assert '"${TICK:-1}"' in script
     recs = [json.loads(ln) for ln in runs.read_text().strip().splitlines()]
     lint_recs = [r for r in recs if r.get("metric") == "jaxlint_new_findings"]
     assert lint_recs and lint_recs[-1]["value"] == 0

@@ -1,0 +1,106 @@
+"""Multi-seed Monte Carlo tick batching (ISSUE 13): the scatter-free
+``lax.map`` executable (parallel/sweep.multi_seed_fn), its
+``runner.run_multi_seed`` entrypoint, and the sweeps' ``multi_seed=`` arm.
+
+Late-alphabet name: these tests compile tick-engine programs (the tier-1
+window rule from tests/test_zsweep_cache.py applies)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from blockchain_simulator_tpu import runner
+from blockchain_simulator_tpu.models.base import canonical_fault_cfg
+from blockchain_simulator_tpu.parallel import partition, sweep
+from blockchain_simulator_tpu.utils import aotcache
+from blockchain_simulator_tpu.utils.config import FaultConfig, SimConfig
+
+
+def _cfg(**kw):
+    # small tick-engine config; stat_sampler pinned "exact" so rows are
+    # bit-stable across the differently-compiled dispatch arms
+    # (parallel/sweep.py CLT float caveat)
+    base = dict(protocol="pbft", n=48, sim_ms=300, schedule="tick",
+                delivery="stat", model_serialization=False,
+                stat_sampler="exact", pbft_max_rounds=5, pbft_max_slots=16)
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def test_run_multi_seed_rows_bit_equal_sequential():
+    cfg = _cfg()
+    seeds = (0, 1, 5)
+    batched = runner.run_multi_seed(cfg, seeds, record=False)
+    solo = [runner.run_simulation(cfg, seed=s) for s in seeds]
+    assert batched == solo
+
+
+def test_multi_seed_one_executable_fresh_seeds_hit():
+    cfg = _cfg(n=32, sim_ms=200, pbft_max_rounds=3, pbft_max_slots=8)
+    s0 = aotcache.registry.stats()
+    runner.run_multi_seed(cfg, (0, 1), record=False)
+    s1 = aotcache.registry.stats()
+    assert s1["misses"] - s0["misses"] >= 1  # fresh structure compiled once
+    # fresh seed VALUES ride the key operand: zero new executables
+    runner.run_multi_seed(cfg, (7, 11), record=False)
+    s2 = aotcache.registry.stats()
+    assert s2["misses"] == s1["misses"]
+    assert s2["hits"] > s1["hits"]
+    # a different seed COUNT is a different batch shape: its own entry
+    runner.run_multi_seed(cfg, (0, 1, 2), record=False)
+    s3 = aotcache.registry.stats()
+    assert s3["misses"] - s2["misses"] == 1
+
+
+def test_fault_sweep_multi_seed_arm_bit_equal_default():
+    cfg = _cfg()
+    fcs = [FaultConfig(n_byzantine=f) for f in (0, 2)]
+    seeds = (0, 3)
+    default = sweep.run_fault_sweep(cfg, fcs, seeds)
+    ms = sweep.run_fault_sweep(cfg, fcs, seeds, multi_seed=True)
+    assert default == ms
+
+
+def test_run_multi_seed_refuses_mixed():
+    cfg = SimConfig(protocol="mixed", n=32, mixed_shards=2, sim_ms=200,
+                    schedule="tick", stat_sampler="exact")
+    with pytest.raises(runner.UnbatchableConfigError):
+        runner.run_multi_seed(cfg, (0, 1), record=False)
+
+
+def test_multi_seed_body_scatter_free():
+    """The #0i pin at the jaxpr level: the lax.map multi-seed body contains
+    NO plain `scatter` primitive (vmap's DUS lowering) — only the inherent
+    scatter-add/max/min window-event accumulators survive, exactly like the
+    mesh arm's per-device body.  The vmapped program over the same sim is
+    the positive control.  (lint/graph baselines pin the same contract in
+    CI via the multi_seed.* budget entries.)"""
+    cfg = canonical_fault_cfg(_cfg(n=16, sim_ms=120, pbft_max_rounds=2,
+                                   pbft_max_slots=8))
+    fn = runner.make_dyn_sim_fn(cfg)
+    keys = jax.vmap(jax.random.key)(jnp.arange(2, dtype=jnp.uint32))
+    cnt = jnp.zeros((2,), jnp.int32)
+
+    from blockchain_simulator_tpu.lint.graph.ir import iter_eqns
+
+    def prims(closed):
+        return [eqn.primitive.name for eqn in iter_eqns(closed)]
+
+    seq_prims = prims(jax.make_jaxpr(partition.seq_map(fn))(keys, cnt, cnt))
+    assert "scatter" not in seq_prims
+    vmap_prims = prims(jax.make_jaxpr(jax.vmap(fn))(keys, cnt, cnt))
+    assert "scatter" in vmap_prims  # the hazard the map arm removes
+
+
+def test_run_dyn_points_multi_seed_mixed_fault_counts():
+    """A sweep tile's points differ in fault COUNTS: the mapped operands
+    carry them, rows bit-equal to the default vmapped dispatch."""
+    cfg = _cfg()
+    canon = canonical_fault_cfg(cfg)
+    points = [
+        (cfg.with_(faults=FaultConfig(n_byzantine=0)), 0),
+        (cfg.with_(faults=FaultConfig(n_byzantine=3)), 1),
+    ]
+    default = sweep.run_dyn_points(canon, points, record=False)
+    ms = sweep.run_dyn_points(canon, points, record=False, multi_seed=True)
+    assert default == ms

@@ -150,6 +150,24 @@ if [ "${MESH_SWEEP:-1}" != "0" ]; then
     fi
 fi
 
+# Tick-engine smoke (tools/tick_bench.py --quick): the multi-seed
+# Monte Carlo tick executable (parallel/sweep.multi_seed_fn) vs the
+# vmapped and sequential dispatch arms on a small grid — rows must be
+# bit-equal (exact sampler) and compile exactly ONE executable; lands
+# tick_rounds_per_s in runs.jsonl where bench_compare gates it
+# higher-is-better.  TICK=0 skips (~1 min of compile on this box); the
+# full-scale artifact run is `python tools/tick_bench.py` and the
+# committed ARTIFACT_tick_bench.json.
+if [ "${TICK:-1}" != "0" ]; then
+    echo "== tick bench smoke =="
+    python tools/tick_bench.py --quick
+    tick_rc=$?
+    if [ "$tick_rc" -ne 0 ]; then
+        echo "lint.sh: tick bench smoke FAILED (rc=$tick_rc)" >&2
+        rc=1
+    fi
+fi
+
 echo "== bench_compare =="
 if [ -n "${BLOCKSIM_RUNS_JSONL:-}" ] && [ -f "${BLOCKSIM_RUNS_JSONL}" ]; then
     python tools/bench_compare.py --runs "${BLOCKSIM_RUNS_JSONL}" "$@"

@@ -21,6 +21,14 @@ The round step is [N]-vector int32/f32 elementwise + PRNG work — no matmuls
 utilization (the binding one for streaming vector code) plus the raw flop
 rate for context.
 
+``ROOFLINE_SCHEDULE=tick`` points the same analysis at the general
+per-tick engine instead of the round fast path (ISSUE 13: the tick path is
+what every windowed-drop / view-change / Byzantine-fallback config runs,
+and its wall is sampling/delivery compute — KNOWN_ISSUES #5).  The tick
+numbers pair with ARTIFACT_tick_bench.json's dispatch-arm ratios: this
+tool prices ONE program against the hardware ceilings, tick_bench prices
+the dispatch arms against each other.
+
 Prints one JSON object; run in a fresh child process (KNOWN_ISSUES.md #2).
 """
 
@@ -33,6 +41,7 @@ import time
 
 N = int(os.environ.get("ROOFLINE_N", "100000"))
 ROUNDS = int(os.environ.get("ROOFLINE_ROUNDS", "2000"))
+SCHEDULE = os.environ.get("ROOFLINE_SCHEDULE", "round")
 V5E_BF16_FLOPS = 197e12
 
 
@@ -49,7 +58,18 @@ def main() -> int:
     cfg = _cfg(ROUNDS)
     from blockchain_simulator_tpu.runner import make_sim_fn, use_round_schedule
 
-    assert use_round_schedule(cfg), "headline config must resolve to the round path"
+    if SCHEDULE == "tick":
+        # the tick-engine roofline: same workload pinned onto the general
+        # engine (the bench _cfg already carries the windowed vote table
+        # it would fall back to)
+        cfg = cfg.with_(schedule="tick")
+        assert not use_round_schedule(cfg)
+    elif SCHEDULE != "round":
+        raise SystemExit(f"unknown ROOFLINE_SCHEDULE {SCHEDULE!r} "
+                         "(expected 'round' or 'tick')")
+    else:
+        assert use_round_schedule(cfg), \
+            "headline config must resolve to the round path"
     sim = make_sim_fn(cfg)
     key = jax.random.key(0)
 
@@ -70,6 +90,7 @@ def main() -> int:
     out = {
         "n": N,
         "rounds": ROUNDS,
+        "schedule": SCHEDULE,
         "backend": jax.default_backend(),
         "rounds_per_sec": round(value, 2),
         "per_round_us": round(per_round_s * 1e6, 1),

@@ -93,6 +93,39 @@ def main():
 
     report("pop_push_pair_18x100kx8", timed(popper, buf0), 2100)
 
+    # multi-seed batching of the SAME pop/push pattern (ISSUE 13 tick-path
+    # arms, n scaled to 10k so the 4-lane batch fits the micro budget):
+    # vmap over the batch lowers each DUS pair to XLA generic scatter
+    # (KNOWN_ISSUES #0b/#0i — the cost the sweeps' vmapped dispatch pays
+    # per tick), while lax.map of the unvmapped body (partition.seq_map,
+    # the multi-seed tick executable's shape) keeps plain DUS at the same
+    # total work.  NOTE the measured micro gap here is small (~7%): ONE
+    # batched scatter on an otherwise-empty scan body is cheap.  The real
+    # tick engine batches 3-4 ring pushes per tick PLUS the gather/compare
+    # chains feeding them, and there the same lowering inflates XLA's own
+    # cost model 4.6x flops/seed (pbft, ARTIFACT_tick_bench.json
+    # cost_per_seed) — these rows pin the MECHANISM's direction at the
+    # floor, tick_bench prices its full-engine magnitude.
+    lanes, iters = 4, 2100
+    buf_s = jnp.zeros((18, 10_000, 8), jnp.int32)
+    bufs_b = jnp.zeros((lanes, 18, 10_000, 8), jnp.int32)
+
+    def ring_scan(buf):
+        def body(b, t):
+            idx = jnp.mod(t, 18)
+            cur = jax.lax.dynamic_index_in_dim(b, idx, 0, keepdims=False)
+            b = jax.lax.dynamic_update_index_in_dim(b, cur + 1, idx, 0)
+            return b, ()
+        return jax.lax.scan(body, buf, jnp.arange(iters))[0]
+
+    # one-shot micro-bench jits, one call each — recompile hazard is moot
+    report(f"pop_push_vmap_{lanes}x18x10kx8",
+           timed(jax.jit(jax.vmap(ring_scan)), bufs_b), iters * lanes)  # jaxlint: disable=static-arg-recompile-hazard
+    report(f"pop_push_seqmap_{lanes}x18x10kx8",
+           timed(jax.jit(lambda bs: jax.lax.map(ring_scan, bs)), bufs_b),  # jaxlint: disable=static-arg-recompile-hazard
+           iters * lanes)
+    report("pop_push_solo_18x10kx8", timed(jax.jit(ring_scan), buf_s), iters)  # jaxlint: disable=static-arg-recompile-hazard
+
 
 if __name__ == "__main__":
     main()

@@ -33,6 +33,101 @@ MONOTONE_KEYS = (
     "disk_saves", "disk_errors", "corrupt_healed",
 )
 
+# Span attrs that are deterministic under a scripted scenario and so may
+# ride the normalized span tree (everything else — durations, batch
+# occupancy/bucket/mode, retry attempt counts — is timing-shaped and
+# excluded, the same rule the scenario summaries apply to stats).
+_SPAN_NORM_ATTRS = ("id", "outcome", "replayed", "replay", "hedge")
+
+
+def normalize_spans(spans) -> list[str]:
+    """Timing-free span-tree summary (utils/telemetry.py records): one
+    sorted string per *serving* span — its root-to-leaf name path plus
+    the deterministic attrs — so two same-seed scenario runs must
+    produce byte-equal lists (the drill's determinism gate now covers
+    span trees, ISSUE 14 satellite).
+
+    ``sweep.*`` spans are excluded: a deadline-abandoned chunk attempt
+    closes its span whenever the abandoned thread finishes, which can
+    land inside one run's capture window and outside the other's — the
+    journal's ``event`` lines are that trail's deterministic record."""
+    by_id: dict[tuple, dict] = {}
+    recs = []
+    for rec in spans:
+        if rec.get("kind") != "span":
+            continue
+        name = str(rec.get("name"))
+        if name.startswith("sweep."):
+            continue
+        by_id[(rec.get("trace"), rec.get("id"))] = rec
+        recs.append(rec)
+    out = []
+    for rec in recs:
+        path = [str(rec.get("name"))]
+        seen = {rec.get("id")}
+        parent = by_id.get((rec.get("trace"), rec.get("parent")))
+        while parent is not None and parent.get("id") not in seen:
+            path.append(str(parent.get("name")))
+            seen.add(parent.get("id"))
+            parent = by_id.get((parent.get("trace"), parent.get("parent")))
+        attrs = rec.get("attrs") or {}
+        kept = ";".join(
+            f"{k}={attrs[k]}" for k in _SPAN_NORM_ATTRS if k in attrs
+        )
+        out.append("/".join(reversed(path))
+                   + f"[{kept}]" + f"~{rec.get('status')}")
+    return sorted(out)
+
+
+def _counter_sum(snapshot: dict, name: str) -> float:
+    """Sum a counter family (bare name + every label set) out of a
+    telemetry.metrics.snapshot()."""
+    total = 0.0
+    for key, v in (snapshot.get("counters") or {}).items():
+        if key == name or key.startswith(name + "{"):
+            total += v
+    return total
+
+
+def check_telemetry(before: dict, after: dict,
+                    lost_admissions: int = 0) -> list[str]:
+    """The metrics-registry accounting contract (utils/telemetry.py),
+    on two ``telemetry.metrics.snapshot()`` brackets of a scenario:
+
+    1. **Conservation** — the serve counter deltas balance exactly like
+       the Ledger: ``received + replayed == answered + rejected`` (every
+       admission the scenario's servers saw left through a counted
+       door).  ``lost_admissions`` is the crash allowance: a scenario
+       that kills a server with admitted-but-unanswered requests
+       declares exactly how many admissions died with it (their WAL
+       replays re-enter through the ``replayed`` counter) — the balance
+       must then be off by exactly that many, no more, no fewer.
+    2. **Monotone** — no counter delta is negative (telemetry counters
+       never rewind, the registry-stats rule applied to telemetry).
+    """
+    violations: list[str] = []
+    deltas = {}
+    for name in ("blocksim_serve_received_total",
+                 "blocksim_serve_replayed_total",
+                 "blocksim_serve_answered_total",
+                 "blocksim_serve_rejected_total"):
+        deltas[name] = _counter_sum(after, name) - _counter_sum(before, name)
+        if deltas[name] < 0:
+            violations.append(
+                f"telemetry counter {name!r} ran backwards "
+                f"(delta {deltas[name]})")
+    entered = (deltas["blocksim_serve_received_total"]
+               + deltas["blocksim_serve_replayed_total"])
+    left = (deltas["blocksim_serve_answered_total"]
+            + deltas["blocksim_serve_rejected_total"])
+    if entered != left + lost_admissions:
+        violations.append(
+            f"telemetry counters do not reconcile: received+replayed="
+            f"{entered} but answered+rejected={left} with "
+            f"{lost_admissions} declared crash-lost admissions "
+            f"(deltas: {deltas})")
+    return violations
+
 
 class Ledger:
     """Client-side record of every submission a scenario made: one

@@ -448,6 +448,10 @@ def scenario_crash_restart(ctl, workdir, quick):
             f"second restart replayed {replay_again} ids (want 0)")
     return {"ledger": ledger, "stats": stats, "violations": violations,
             "replayed_ids": [rid for rid, _ in crash_points],
+            # the crashed server died holding these admissions: the
+            # telemetry conservation check (invariants.check_telemetry)
+            # must see the balance off by exactly this many
+            "lost_admissions": len(crash_points),
             "extra": {"replay_divergence": divergence,
                       "replayed": stats["replayed"],
                       "replay_again": replay_again}}
@@ -614,14 +618,17 @@ def run_scenario(name: str, seed: int, workdir: str | None = None,
     counters, the fired chaos schedule and every invariant violation —
     and nothing timing-shaped, so two same-seed runs must compare equal
     (the drill's determinism gate)."""
+    from blockchain_simulator_tpu.utils import telemetry
+
     fn = SCENARIOS[name]
     workdir = workdir or tempfile.mkdtemp(prefix=f"chaos_{name}_")
     log = os.path.join(workdir, "access.jsonl")
     prev = os.environ.get(obs.RUNS_ENV)
     os.environ[obs.RUNS_ENV] = log
     reg_before = aotcache.registry.stats()
+    tel_before = telemetry.metrics.snapshot()
     try:
-        with inject.controller(seed) as ctl:
+        with inject.controller(seed) as ctl, telemetry.capture() as spans:
             rep = fn(ctl, workdir, quick)
             schedule = ctl.schedule()
     finally:
@@ -630,6 +637,7 @@ def run_scenario(name: str, seed: int, workdir: str | None = None,
         else:
             os.environ[obs.RUNS_ENV] = prev
     reg_after = aotcache.registry.stats()
+    tel_after = telemetry.metrics.snapshot()
     violations = list(rep.get("violations") or [])
     ledger, stats = rep.get("ledger"), rep.get("stats")
     if stats is not None:
@@ -640,12 +648,24 @@ def run_scenario(name: str, seed: int, workdir: str | None = None,
         )
     else:
         violations += invariants.registry_monotone(reg_before, reg_after)
+    # the telemetry cross-checks (ISSUE 14): counter deltas must conserve
+    # like the Ledger, and the scenario's serving span trees — normalized
+    # timing-free — ride the summary, so the drill's byte-equal
+    # determinism gate now covers telemetry too
+    violations += invariants.check_telemetry(
+        tel_before, tel_after,
+        lost_admissions=int(rep.get("lost_admissions", 0)))
+    if violations:
+        telemetry.flight.note("chaos.invariant_violation", scenario=name,
+                              n=len(violations))
+        telemetry.flight.dump("invariant-violation")
     return {
         "scenario": name,
         "seed": seed,
         "outcomes": ledger.kinds() if ledger is not None else None,
         "counts": _counts(stats) if stats is not None else None,
         "chaos_schedule": schedule,
+        "span_tree": invariants.normalize_spans(spans),
         "violations": violations,
         **{k: v for k, v in (rep.get("extra") or {}).items()},
     }

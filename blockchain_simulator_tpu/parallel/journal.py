@@ -369,6 +369,14 @@ def run_supervised(primary, degrade, sup: ChunkSupervisor,
         events.append(event)
         if journal is not None:
             journal.append_event(key, event, **fields)
+        # the flight recorder (utils/telemetry.py) mirrors the trail and
+        # turns a degrade/terminal-failure into an atomic post-mortem
+        # dump when $BLOCKSIM_FLIGHT_DIR is armed (ring-only otherwise)
+        from blockchain_simulator_tpu.utils import telemetry
+
+        telemetry.flight.note(f"sweep.{event}", key=key, **fields)
+        if event in ("degrade", "failed"):
+            telemetry.flight.dump(f"supervisor-{event}")
 
     last_err: BaseException | None = None
     for attempt in range(1, sup.retries + 2):

@@ -59,7 +59,7 @@ from blockchain_simulator_tpu.runner import (
     make_dyn_sim_fn,
     make_sim_fn,
 )
-from blockchain_simulator_tpu.utils import aotcache, obs
+from blockchain_simulator_tpu.utils import aotcache, obs, telemetry
 from blockchain_simulator_tpu.utils.config import SimConfig
 
 
@@ -255,7 +255,12 @@ def _dispatch_dyn_points(canon: SimConfig, points, record: bool = True,
     ops = [_dyn_operands(cfg, cfg.faults) for cfg, _ in dispatch_points]
     nc = jnp.asarray([o[0] for o in ops], jnp.int32)
     nb = jnp.asarray([o[1] for o in ops], jnp.int32)
-    finals = jax.block_until_ready(batched(keys, nc, nb))
+    # BLOCKSIM_PROFILE arms a jax.profiler capture around the executable
+    # run (utils/telemetry.py; free when disarmed).  A serve flush that
+    # routed here is already inside its own profile_region — the nested
+    # guard skips this one.
+    with telemetry.profile_region("sweep_dispatch"):
+        finals = jax.block_until_ready(batched(keys, nc, nb))
     out = []
     if n_out is not None:
         points = points[:n_out]
@@ -281,8 +286,13 @@ def _run_chunk(canon, tile, record, n_out, mesh, supervise, journal, key,
         inject.chaos_point("sweep.chunk", key=key, index=index,
                            n=len(tile), arm="primary",
                            mesh=mesh is not None)
-        return _dispatch_dyn_points(canon, tile, record, n_out, mesh,
-                                    multi_seed)
+        # every chunk ATTEMPT is one span on a chunk-scoped trace
+        # (utils/telemetry.py; the ISSUE 14 sweep-side mint point): the
+        # post-mortem story "which chunk, which arm, how long" as data
+        with telemetry.span("sweep.chunk", key=key, index=index,
+                            n=len(tile), arm="primary"):
+            return _dispatch_dyn_points(canon, tile, record, n_out, mesh,
+                                        multi_seed)
 
     if supervise is None:
         return primary()
@@ -304,10 +314,13 @@ def _run_chunk(canon, tile, record, n_out, mesh, supervise, journal, key,
 
             from blockchain_simulator_tpu import runner as runner_mod
 
-            m, _ = runner_mod.run_dyn_checkpointed(
-                cfg_pt, supervise.checkpoint_every_ms,
-                _os.path.join(supervise.checkpoint_dir, key), seed=seed_pt,
-            )
+            with telemetry.span("sweep.chunk", key=key, index=index,
+                                n=len(tile), arm="degrade-checkpoint"):
+                m, _ = runner_mod.run_dyn_checkpointed(
+                    cfg_pt, supervise.checkpoint_every_ms,
+                    _os.path.join(supervise.checkpoint_dir, key),
+                    seed=seed_pt,
+                )
             return [m]
     else:
         # the mesh-shrink arm (partition.py's size-1/no-mesh path): the
@@ -316,8 +329,10 @@ def _run_chunk(canon, tile, record, n_out, mesh, supervise, journal, key,
         def degrade():
             inject.chaos_point("sweep.chunk", key=key, index=index,
                                n=len(tile), arm="degrade", mesh=False)
-            return _dispatch_dyn_points(canon, tile, record, n_out,
-                                        mesh=None)
+            with telemetry.span("sweep.chunk", key=key, index=index,
+                                n=len(tile), arm="degrade"):
+                return _dispatch_dyn_points(canon, tile, record, n_out,
+                                            mesh=None)
 
     rows, _events = journal_mod.run_supervised(
         primary, degrade, supervise, journal=journal, key=key,

@@ -95,6 +95,12 @@ def make_httpd(server, host: str = "127.0.0.1", port: int = 0):
         def do_GET(self):
             if self.path == "/stats":
                 self._send(200, server.stats())
+            elif self.path == "/metrics":
+                # Prometheus text exposition (utils/telemetry.py):
+                # process-wide counters/gauges/histograms
+                from blockchain_simulator_tpu.utils import telemetry
+
+                telemetry.write_exposition(self)
             elif self.path == "/healthz":
                 ready = not server.paused and not server._closing
                 self._send(200 if ready else 503, {
@@ -115,7 +121,16 @@ def make_httpd(server, host: str = "127.0.0.1", port: int = 0):
                         "error": "body is not valid JSON",
                     })
                     return
-                resp = server.request(obj)
+                # adopt the router's trace context (X-Blocksim-Trace) so
+                # this replica's span tree parents to the router's send
+                # span (utils/telemetry.py; a missing/garbled header just
+                # mints a fresh trace — never a rejection)
+                from blockchain_simulator_tpu.utils import telemetry
+
+                ctx = telemetry.parse_header(
+                    self.headers.get(telemetry.TRACE_HEADER))
+                with telemetry.context(ctx):
+                    resp = server.request(obj)
                 self._send(resp.get("code", 500), resp)
             elif self.path == "/health":
                 obj = self._read_json()
@@ -370,9 +385,12 @@ def main(argv=None) -> int:
         return self_test(args)
 
     from blockchain_simulator_tpu.serve.server import ScenarioServer
-    from blockchain_simulator_tpu.utils import aotcache
+    from blockchain_simulator_tpu.utils import aotcache, telemetry
 
     aotcache.enable_xla_cache()
+    # an unhandled daemon exception leaves a flight-recorder post-mortem
+    # (when $BLOCKSIM_FLIGHT_DIR is armed) before the traceback
+    telemetry.install_crash_dump()
     mesh = None
     if args.mesh_sweep and args.mesh_sweep > 1:
         from blockchain_simulator_tpu.parallel.mesh import make_mesh

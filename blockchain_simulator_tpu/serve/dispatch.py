@@ -41,7 +41,7 @@ from blockchain_simulator_tpu.chaos import inject
 from blockchain_simulator_tpu.models.base import get_protocol
 from blockchain_simulator_tpu.runner import make_dyn_sim_fn
 from blockchain_simulator_tpu.serve import schema
-from blockchain_simulator_tpu.utils import aotcache, obs
+from blockchain_simulator_tpu.utils import aotcache, obs, telemetry
 
 
 @aotcache.cached_factory("serve-solo")
@@ -79,13 +79,26 @@ def _operands(reqs):
 def _solo_metrics(req):
     """Run one request through the solo executable; returns its metrics.
     The ``serve.solo_dispatch`` chaos point fires first with the request
-    id, so a drill can poison exactly one request (chaos/inject.py)."""
-    inject.chaos_point("serve.solo_dispatch", req_id=req.req_id)
-    keys, nc, nb = _operands([req])
-    final = jax.block_until_ready(
-        _solo_fn(req.canon)(keys[0], nc[0], nb[0])
-    )
-    return get_protocol(req.cfg.protocol).metrics(req.cfg, final)
+    id, so a drill can poison exactly one request (chaos/inject.py).
+    Dispatch stamps (telemetry span synthesis, serve/server._answer) and
+    the ``$BLOCKSIM_PROFILE`` capture bracket the executable run — all
+    host-side, per the telemetry rule."""
+    # stamp BEFORE the chaos point (and fire the point INSIDE the
+    # try/finally): a poisoned request that raises at the injection
+    # still records a near-zero dispatch-attempt span instead of
+    # leaving a stale batched-dispatch stamp — or no stamp at all —
+    # behind for the span synthesizer
+    req.t_dispatch0 = time.monotonic()
+    try:
+        inject.chaos_point("serve.solo_dispatch", req_id=req.req_id)
+        with telemetry.profile_region("serve_solo"):
+            keys, nc, nb = _operands([req])
+            final = jax.block_until_ready(
+                _solo_fn(req.canon)(keys[0], nc[0], nb[0])
+            )
+        return get_protocol(req.cfg.protocol).metrics(req.cfg, final)
+    finally:
+        req.t_dispatch1 = time.monotonic()
 
 
 def run_batch(reqs, max_batch: int, force_solo: bool = False,
@@ -172,10 +185,17 @@ def run_batch(reqs, max_batch: int, force_solo: bool = False,
         # the sweeps' group-dispatch primitive, fed the queue instead of a
         # cross product; record=False — the server writes its own per-
         # request access-log records; n_out skips pad-lane metrics
-        rows = sweep.run_dyn_points(
-            canon, [(r.cfg, r.seed) for r in lanes], record=False,
-            n_out=len(reqs), mesh=mesh, journal=journal,
-        )
+        d0 = time.monotonic()
+        try:
+            with telemetry.profile_region("serve_flush"):
+                rows = sweep.run_dyn_points(
+                    canon, [(r.cfg, r.seed) for r in lanes], record=False,
+                    n_out=len(reqs), mesh=mesh, journal=journal,
+                )
+        finally:
+            d1 = time.monotonic()
+            for req in reqs:
+                req.t_dispatch0, req.t_dispatch1 = d0, d1
         out = []
         for req, m in zip(reqs, rows):
             latency = time.monotonic() - (req.submitted or t0)
@@ -183,7 +203,13 @@ def run_batch(reqs, max_batch: int, force_solo: bool = False,
         return out
     except Exception:
         # a batch peer failed: serve every lane solo so one poisoned
-        # request cannot take its neighbors' answers down with it
+        # request cannot take its neighbors' answers down with it.  The
+        # failed flush's stamps are cleared first — each solo retry
+        # below re-stamps its own attempt, and a request whose solo
+        # never starts must not carry the dead batched dispatch's
+        # timing as if it ran
+        for req in reqs:
+            req.t_dispatch0 = req.t_dispatch1 = 0.0
         out = []
         solo = {"size": len(reqs), "padded": 1, "mode": "degraded-solo",
                 "group": group, "degraded": True}

@@ -195,6 +195,7 @@ def handoff_wal(wal_path: str, owner: str, post, on_answer=None,
     ``replica-lost``; the lease holder's replay is the one true replay).
     """
     from blockchain_simulator_tpu.serve import schema
+    from blockchain_simulator_tpu.utils import telemetry
 
     if not claim_wal(wal_path, owner):
         return {"claimed": False, "owner": claim_owner(wal_path),
@@ -206,7 +207,18 @@ def handoff_wal(wal_path: str, owner: str, post, on_answer=None,
         obj = dict(raw) if isinstance(raw, dict) else {}
         obj["id"] = rid
         try:
-            _status, body = post(obj)
+            # each replay is its own FRESH trace (the dead replica's
+            # original trace died with it) — context(None) clears any
+            # trace the calling thread happens to carry, so a replay can
+            # never graft onto an unrelated live request's tree.  The
+            # span context rides the peer POST via the router's header
+            # injection, marked replay=True so span trees separate
+            # replays from live traffic.
+            with telemetry.context(None), \
+                    telemetry.span("fleet.handoff_replay",
+                                   id=rid, replay=True, owner=str(owner),
+                                   wal=os.path.basename(str(wal_path))):
+                _status, body = post(obj)
             body = dict(body)
         except Exception as e:
             # the replay itself could not dispatch (no live peer): the

@@ -60,7 +60,7 @@ import urllib.request
 from blockchain_simulator_tpu.chaos import inject
 from blockchain_simulator_tpu.serve import schema
 from blockchain_simulator_tpu.serve.server import CircuitBreaker
-from blockchain_simulator_tpu.utils import obs
+from blockchain_simulator_tpu.utils import obs, telemetry
 
 
 def _transport_kind(exc: BaseException) -> str:
@@ -92,7 +92,7 @@ class RouterPending:
     serve/server.py's PendingResponse semantics)."""
 
     __slots__ = ("_event", "_lock", "_response", "req_id", "primary_id",
-                 "answered_at")
+                 "answered_at", "submitted_at", "trace_id", "root_span")
 
     def __init__(self, req_id: str):
         self._event = threading.Event()
@@ -103,6 +103,16 @@ class RouterPending:
         self.answered_at = None  # monotonic stamp of the winning answer:
         # open-loop clients collect long after resolution, so latency must
         # be measured here, not at result()
+        self.submitted_at = time.monotonic()
+        # trace identity (utils/telemetry.py): the trace is minted at
+        # router admission; the root span id is allocated NOW so send/
+        # hedge/replay children can parent to it before the root closes
+        # at the winning answer
+        self.trace_id = telemetry.new_trace_id()
+        self.root_span = telemetry.new_span_id()
+
+    def root_ctx(self) -> "telemetry.TraceContext":
+        return telemetry.TraceContext(self.trace_id, self.root_span)
 
     def _set_once(self, response: dict) -> bool:
         with self._lock:
@@ -211,6 +221,11 @@ class FleetRouter:
             "late_answers": 0, "parked_total": 0, "handoff_lost": 0,
         }
         self._handoffs: list[dict] = []
+        # private fleet-latency histogram behind /stats "latency_ms"
+        # (utils/telemetry.py; the global registry gets the same
+        # observations for /metrics)
+        self._hist = telemetry.Histogram("fleet_request_latency_ms", {},
+                                         threading.Lock())
         self._threads: list[threading.Thread] = []
         self._prober: threading.Thread | None = None
         if probe:
@@ -221,10 +236,17 @@ class FleetRouter:
     # ------------------------------------------------------------ plumbing
     def _http(self, method: str, base: str, path: str, obj=None,
               timeout: float = 60.0):
+        headers = {"Content-Type": "application/json"}
+        ctx = telemetry.current()
+        if ctx is not None:
+            # propagate the caller's span (a router.send span around this
+            # call) so the replica's serve.request parents to it — the
+            # cross-process half of the trace (utils/telemetry.py)
+            headers[telemetry.TRACE_HEADER] = ctx.header()
         data = None if obj is None else json.dumps(obj).encode()
         req = urllib.request.Request(
             f"{base}{path}", data=data, method=method,
-            headers={"Content-Type": "application/json"},
+            headers=headers,
         )
         try:
             with urllib.request.urlopen(req, timeout=timeout) as r:
@@ -248,11 +270,36 @@ class FleetRouter:
         already logged by the replica itself."""
         if pending._set_once(body):
             self._count_answer(body)
+            try:
+                # the winning answer closes the trace's root span and
+                # lands the open-loop latency (submit -> answered_at) on
+                # the fleet histograms — hedge losers never reach here
+                kind = "ok" if body.get("status") == "ok" \
+                    else str(body.get("kind"))
+                telemetry.emit(
+                    "router.request", pending.submitted_at,
+                    pending.answered_at, trace=pending.trace_id,
+                    span_id=pending.root_span,
+                    status="ok" if kind == "ok" else "error",
+                    id=pending.req_id, outcome=kind,
+                    hedged=body.get("hedged"),
+                    replayed=body.get("replayed"),
+                )
+                ms = (pending.answered_at - pending.submitted_at) * 1000.0
+                self._hist.observe(ms)
+                telemetry.metrics.histogram(
+                    "blocksim_fleet_request_latency_ms").observe(ms)
+                telemetry.metrics.counter(
+                    "blocksim_fleet_answered_total", kind=kind).inc()
+            except Exception:
+                pass  # telemetry must never block the answer
             if log:
                 obs.record_run(body, None)
         else:
             with self._lock:
                 self._stats["late_answers"] += 1
+            telemetry.metrics.counter(
+                "blocksim_fleet_late_answers_total").inc()
 
     # ------------------------------------------------------------- routing
     def _routable(self, now: float) -> list[_Endpoint]:
@@ -308,6 +355,7 @@ class FleetRouter:
             req_id = str((obj or {}).get("id", "")
                          if isinstance(obj, dict) else "") \
                 or f"fr{next(self._ids)}"
+        telemetry.metrics.counter("blocksim_fleet_received_total").inc()
         pending = RouterPending(req_id)
         group = None
         if self.validate:
@@ -355,6 +403,8 @@ class FleetRouter:
             if attempt:
                 with self._lock:
                     self._stats["retries"] += 1
+                telemetry.metrics.counter(
+                    "blocksim_fleet_retries_total").inc()
                 time.sleep(self.retry_backoff_s * (2.0 ** (attempt - 1)))
             obj = dict(obj)
             obj["id"] = req_id
@@ -364,9 +414,15 @@ class FleetRouter:
             inject.chaos_point("fleet.send", replica=rep.id, req_id=req_id)
             tried.add(rep.id)
             try:
-                status, body = self._http(
-                    "POST", rep.base_url, "/scenario", obj,
-                    timeout=self.request_timeout_s)
+                # the send span: child of the trace's root, and (via the
+                # thread-local context _http reads) the parent the
+                # replica's serve.request span hangs off
+                with telemetry.span("router.send", ctx=pending.root_ctx(),
+                                    replica=rep.id, attempt=attempt,
+                                    id=req_id):
+                    status, body = self._http(
+                        "POST", rep.base_url, "/scenario", obj,
+                        timeout=self.request_timeout_s)
             except Exception as e:
                 now = time.monotonic()
                 with self._lock:
@@ -417,6 +473,7 @@ class FleetRouter:
             return
         with self._lock:
             self._stats["hedges"] += 1
+        telemetry.metrics.counter("blocksim_fleet_hedges_total").inc()
         # a different replica than the silent primary (affinity ignored —
         # the whole point is escaping the preferred replica); when only
         # the primary is routable, _pick's `or cands` fallback still
@@ -429,8 +486,11 @@ class FleetRouter:
         obj["id"] = req_id
         inject.chaos_point("fleet.send", replica=rep.id, req_id=req_id)
         try:
-            status, body = self._http("POST", rep.base_url, "/scenario",
-                                      obj, timeout=self.request_timeout_s)
+            with telemetry.span("router.send", ctx=pending.root_ctx(),
+                                replica=rep.id, hedge=True, id=req_id):
+                status, body = self._http(
+                    "POST", rep.base_url, "/scenario",
+                    obj, timeout=self.request_timeout_s)
         except Exception:
             return  # the primary (or the handoff) remains responsible
         with self._lock:
@@ -623,6 +683,10 @@ class FleetRouter:
                 **{k: (dict(v) if isinstance(v, dict) else v)
                    for k, v in self._stats.items()},
                 "handoffs": [dict(h) for h in self._handoffs],
+                # open-loop fleet latency percentiles (submit -> winning
+                # answer) from the telemetry histogram — the satellite
+                # peer of the replica-side /stats latency_ms block
+                "latency_ms": {"request": self._hist.percentiles()},
                 "replicas": {ep.id: ep.snapshot()
                              for ep in self._endpoints},
                 "knobs": {
@@ -672,6 +736,8 @@ def make_router_httpd(router: FleetRouter, host: str = "127.0.0.1",
         def do_GET(self):
             if self.path == "/stats":
                 self._send(200, router.stats())
+            elif self.path == "/metrics":
+                telemetry.write_exposition(self)
             elif self.path == "/healthz":
                 up = bool(router._pick(None))
                 self._send(200 if up else 503, {"ready": up})

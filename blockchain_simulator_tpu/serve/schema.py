@@ -185,6 +185,21 @@ class ScenarioRequest:
     timeout_s: float
     submitted: float = 0.0
     replayed: bool = False
+    # -- telemetry (utils/telemetry.py; host-side only) --------------------
+    # trace identity: minted at admission (or adopted from the router's
+    # X-Blocksim-Trace header, in which case parent_span is the router's
+    # send-span id), so the replica's span tree hangs off the fleet's
+    trace_id: str | None = None
+    parent_span: str | None = None
+    t_admit: float = 0.0
+    # lifecycle stamps (time.monotonic), filled as the request moves
+    # batcher-side; the server synthesizes the segment spans (queue_wait /
+    # batch_wait / dispatch / answer) from these at answer time, because
+    # the segments straddle the submitter, batcher and dispatch
+    t_drained: float = 0.0
+    t_flush: float = 0.0
+    t_dispatch0: float = 0.0
+    t_dispatch1: float = 0.0
 
     def expired(self, now: float) -> bool:
         return self.timeout_s > 0 and (now - self.submitted) > self.timeout_s

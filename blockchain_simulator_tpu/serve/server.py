@@ -64,7 +64,7 @@ from blockchain_simulator_tpu.parallel.partition import (
 )
 from blockchain_simulator_tpu.serve import dispatch, schema
 from blockchain_simulator_tpu.serve.wal import WriteAheadLog
-from blockchain_simulator_tpu.utils import aotcache, obs
+from blockchain_simulator_tpu.utils import aotcache, obs, telemetry
 
 _SHUTDOWN = object()
 
@@ -230,6 +230,16 @@ class ScenarioServer:
             "degraded_batches": 0, "rejected": {}, "errors": 0,
             "replayed": 0, "quarantined": 0, "batcher_restarts": 0,
         }
+        # PRIVATE latency histograms (utils/telemetry.py) behind the
+        # /stats "latency_ms" percentiles: per-server so N servers in one
+        # process (tests, LocalReplica drills) don't blur each other;
+        # the process-global `telemetry.metrics` registry (the /metrics
+        # exposition) is fed the same observations in _answer
+        self._hists = {
+            seg: telemetry.Histogram(f"serve_{seg}_ms", {},
+                                     threading.Lock())
+            for seg in ("request", "queue_wait", "batch_wait", "dispatch")
+        }
         self._occupancy: dict[int, int] = {}
         self._breakers: dict[str, CircuitBreaker] = {}
         self._quarantine: set[str] = set()
@@ -305,6 +315,12 @@ class ScenarioServer:
         self._reject_shutdown(leftovers)
         if self._wal is not None:
             self._wal.close()
+        # flight-recorder post-mortem (utils/telemetry.py): a no-op file-
+        # wise unless $BLOCKSIM_FLIGHT_DIR is armed, so every drill/test
+        # shutdown stays free; the ring note is always recorded
+        telemetry.flight.note("serve.shutdown", replica=self.replica,
+                              drain=self._drain)
+        telemetry.flight.dump("shutdown")
 
     def __enter__(self):
         return self
@@ -330,7 +346,7 @@ class ScenarioServer:
         return self._health["verdict"] != "healthy"
 
     def _reject(self, err: schema.ServeError, req_id: str | None,
-                cfg=None) -> schema.ServeError:
+                cfg=None, t0: float | None = None) -> schema.ServeError:
         """Count + access-log a rejection BEFORE the caller sees it: the
         no-silent-drop contract — every backpressure/admission/validation
         refusal leaves a manifest line when the access log is enabled."""
@@ -338,35 +354,61 @@ class ScenarioServer:
             by_kind = self._stats["rejected"]
             by_kind[err.kind] = by_kind.get(err.kind, 0) + 1
         obs.record_run(err.to_response(req_id), cfg)
+        try:
+            # admission rejections close their (tiny) span tree here; the
+            # rejected counter is the reconciliation peer of the stats
+            # `rejected` map (chaos/invariants.check_telemetry)
+            now = time.monotonic()
+            ctx = telemetry.current()
+            telemetry.emit(
+                "serve.request", t0 if t0 is not None else now, now,
+                trace=ctx.trace_id if ctx else None,
+                parent=ctx.span_id if ctx else None, status="error",
+                id=req_id, outcome=err.kind, replica=self.replica,
+            )
+            telemetry.metrics.counter("blocksim_serve_rejected_total",
+                                      kind=err.kind).inc()
+        except Exception:
+            pass  # telemetry must never block the rejection
         return err
 
     def submit(self, obj: dict) -> PendingResponse:
         """Admission-check + enqueue one JSON scenario request.  Raises a
         typed :class:`~blockchain_simulator_tpu.serve.schema.ServeError`
         (already access-logged) on rejection."""
+        t_admit = time.monotonic()
         with self._lock:
             self._stats["received"] += 1
             req_id = str((obj or {}).get("id", "")
                          if isinstance(obj, dict) else "") \
                 or f"r{next(self._ids)}"
             closing, health = self._closing, dict(self._health)
+        telemetry.metrics.counter("blocksim_serve_received_total").inc()
         if closing:
             raise self._reject(
-                schema.ShuttingDownError("server is draining"), req_id)
+                schema.ShuttingDownError("server is draining"), req_id,
+                t0=t_admit)
         if health["verdict"] != "healthy":
             raise self._reject(
                 schema.AdmissionPausedError(
                     f"admission paused: backend health verdict is "
                     f"{health['verdict']!r} (source: {health['source']})"
                 ),
-                req_id,
+                req_id, t0=t_admit,
             )
         try:
             req = schema.parse_request(
                 obj, req_id, default_timeout_s=self.default_timeout_s
             )
         except schema.ServeError as e:
-            raise self._reject(e, req_id)
+            raise self._reject(e, req_id, t0=t_admit)
+        # trace identity: adopt the router's context (the HTTP handler
+        # installed it from the X-Blocksim-Trace header) or mint a fresh
+        # trace — either way the answer-time span tree has a home
+        ctx = telemetry.current()
+        req.trace_id = ctx.trace_id if ctx else telemetry.new_trace_id()
+        req.parent_span = ctx.span_id if ctx else None
+        req.t_admit = t_admit
         pending = PendingResponse(req.req_id)
         # depth check, flag re-check, WAL admit and enqueue are ONE atomic
         # step: after close() flips _closing under this lock, nothing new
@@ -391,13 +433,13 @@ class ScenarioServer:
         if closing:
             raise self._reject(
                 schema.ShuttingDownError("server is draining"),
-                req.req_id, req.cfg)
+                req.req_id, req.cfg, t0=t_admit)
         if full:
             raise self._reject(
                 schema.QueueFullError(
                     f"queue at capacity ({self.max_queue}); retry later"
                 ),
-                req.req_id, req.cfg,
+                req.req_id, req.cfg, t0=t_admit,
             )
         return pending
 
@@ -432,6 +474,7 @@ class ScenarioServer:
         for rid, obj in pend:
             with self._lock:
                 self._stats["replayed"] += 1
+            telemetry.metrics.counter("blocksim_serve_replayed_total").inc()
             try:
                 req = schema.parse_request(
                     dict(obj) if isinstance(obj, dict) else obj, rid,
@@ -443,10 +486,14 @@ class ScenarioServer:
                 with self._lock:
                     by_kind = self._stats["rejected"]
                     by_kind[e.kind] = by_kind.get(e.kind, 0) + 1
+                telemetry.metrics.counter("blocksim_serve_rejected_total",
+                                          kind=e.kind).inc()
                 obs.record_run(resp, None)
                 self._wal_done(rid, e.code)
                 continue
             req.replayed = True
+            req.trace_id = telemetry.new_trace_id()
+            req.t_admit = now
             req.submitted = now  # the original clock died with the crash
             with self._lock:
                 self._depth += 1
@@ -497,6 +544,7 @@ class ScenarioServer:
                     closing = True
                 else:
                     req, fut = item
+                    req.t_drained = time.monotonic()
                     key = (_QUARANTINE_GROUP, req.req_id) \
                         if req.req_id in self._quarantine else req.canon
                     pending.setdefault(key, []).append((req, fut))
@@ -560,6 +608,16 @@ class ScenarioServer:
             else:
                 by_kind = self._stats["rejected"]
                 by_kind[counter] = by_kind.get(counter, 0) + 1
+        # the conservation-critical counter rides OUTSIDE the best-effort
+        # span synthesis: a span bug must never make check_telemetry's
+        # received+replayed == answered+rejected balance report a false
+        # serving violation
+        telemetry.metrics.counter("blocksim_serve_answered_total",
+                                  outcome=counter).inc()
+        try:
+            self._emit_request_spans(req, resp, counter)
+        except Exception:
+            pass  # telemetry must never block the answer
         try:
             # the logged copy carries the re-submittable request template
             # (non-default fields only) so --prewarm-from can replay the
@@ -567,11 +625,66 @@ class ScenarioServer:
             log_rec = dict(resp)
             log_rec["scenario"] = schema.scenario_template(req.cfg,
                                                            req.seed)
+            if req.trace_id:
+                log_rec["trace"] = req.trace_id
             obs.record_run(log_rec, req.cfg)
         except Exception:
             pass  # the access log must never block the answer
         self._wal_done(req.req_id, resp.get("code"))
         fut._set(resp)
+
+    def _emit_request_spans(self, req, resp: dict, counter: str) -> None:
+        """Synthesize the request's span tree from its lifecycle stamps
+        (utils/telemetry.py; README "Telemetry" documents the model).
+
+        The segments tile [admit, answer] — serve.admit, serve.queue_wait
+        (arrivals queue), serve.batch_wait (grouped, waiting for the
+        flush), serve.dispatch (the executable; pad-bucket/mode attrs)
+        and serve.answer — so a span tree accounts for the request's
+        whole wall time by construction.  Built HERE, at answer time,
+        because the segments straddle the submitter thread, the batcher
+        and the dispatch; stamps a segment never reached (a 504 expiring
+        pre-dispatch has no t_dispatch0) skip that segment."""
+        t_ans = time.monotonic()
+        tid = req.trace_id or telemetry.new_trace_id()
+        t0 = req.t_admit or req.submitted or t_ans
+        status = "ok" if resp.get("status") == "ok" else "error"
+        root = telemetry.emit(
+            "serve.request", t0, t_ans, trace=tid, parent=req.parent_span,
+            status=status, id=req.req_id, outcome=counter,
+            replayed=req.replayed or None, replica=self.replica,
+        )
+        # ONE segment table drives both the span emits and the latency
+        # histograms (private /stats percentiles + the process-global
+        # /metrics registry), so the two surfaces can never disagree
+        # about a segment's boundaries: (span name, t0, t1, histogram
+        # name or None, extra span attrs)
+        batch = resp.get("batch") or {}
+        segments = (
+            ("serve.admit", req.t_admit, req.submitted, None, {}),
+            ("serve.queue_wait", req.submitted, req.t_drained,
+             "queue_wait", {}),
+            ("serve.batch_wait", req.t_drained, req.t_flush,
+             "batch_wait", {}),
+            ("serve.dispatch", req.t_dispatch0, req.t_dispatch1,
+             "dispatch",
+             {"mode": batch.get("mode"), "size": batch.get("size"),
+              "bucket": batch.get("padded"), "group": batch.get("group"),
+              "mesh": batch.get("mesh")}),
+            ("serve.answer", req.t_dispatch1, t_ans, None, {}),
+            (None, req.submitted or t0, t_ans, "request", {}),
+        )
+        for name, a, b, hist, attrs in segments:
+            if not (a and b and b >= a):
+                continue
+            if name is not None:
+                telemetry.emit(name, a, b, trace=tid, parent=root,
+                               id=req.req_id, **attrs)
+            if hist is not None:
+                ms = (b - a) * 1000.0
+                self._hists[hist].observe(ms)
+                telemetry.metrics.histogram(
+                    f"blocksim_serve_{hist}_ms").observe(ms)
 
     def _reject_shutdown(self, group) -> None:
         """Flush still-unanswered requests as typed 503s with rejection
@@ -619,6 +732,7 @@ class ScenarioServer:
                 self._answer(req, fut, err.to_response(req.req_id),
                              "timeouts")
             else:
+                req.t_flush = now
                 live.append((req, fut))
         if not live:
             return
@@ -694,6 +808,11 @@ class ScenarioServer:
                 "health": dict(self._health),
                 "closing": self._closing,
                 "quarantine_size": len(self._quarantine),
+                # per-segment latency percentiles from the telemetry
+                # histograms (ISSUE 14 satellite: sub-capacity latency
+                # visible without running tools/fleet_bench.py)
+                "latency_ms": {seg: h.percentiles()
+                               for seg, h in self._hists.items()},
                 "breakers": {k: br.snapshot()
                              for k, br in sorted(self._breakers.items())},
                 "knobs": {

@@ -39,6 +39,15 @@ OBS_SCHEMA = 1
 # line each) to this path.  Unset = no file I/O (the default for tests).
 RUNS_ENV = "BLOCKSIM_RUNS_JSONL"
 
+# Size cap for every rolling JSONL this writer appends to (runs.jsonl,
+# HEALTH.jsonl via utils/health.py, telemetry span logs): when the file
+# exceeds the cap it rotates to ``<path>.1`` (one generation kept) before
+# the append, so multi-drill processes never grow a log without bound.
+# The default is far above any single drill's output; set the env to a
+# small value to exercise rotation (tests do).  0 disables rotation.
+LOG_MAX_ENV = "BLOCKSIM_LOG_MAX_BYTES"
+LOG_MAX_BYTES_DEFAULT = 64 * 1024 * 1024
+
 
 def _dist_version(name: str) -> str | None:
     """Installed package version without importing the package."""
@@ -110,6 +119,19 @@ def manifest(cfg=None, backend=None, device_count=None) -> dict:
         rec["cache"] = aotcache.registry.manifest()
     except Exception:  # provenance, never a failure mode
         pass
+    try:
+        # telemetry provenance (utils/telemetry.py): compact counter
+        # totals + spans recorded, attached only once the process has
+        # actually counted something — a bare sim run's manifest stays
+        # the size it always was.  telemetry is pure-stdlib host code
+        # (no jax), so this is safe from the bench parent's no-jax path.
+        from blockchain_simulator_tpu.utils import telemetry
+
+        tel = telemetry.metrics.manifest()
+        if tel.get("counters"):
+            rec["telemetry"] = tel
+    except Exception:  # provenance, never a failure mode
+        pass
     return rec
 
 
@@ -163,12 +185,7 @@ def timed_run(sim, key, measure_key=None):
     return final, compile_s, run_s
 
 
-def read_jsonl(path: str) -> list[dict]:
-    """Every parseable dict record of a JSONL file, in order — the one
-    tolerant reader the rolling logs share (runs.jsonl access-log checks
-    in chaos/invariants.py, health verdicts).  Torn lines (a crash or a
-    concurrent append mid-write) and non-dict records are skipped; a
-    missing file reads as empty — log readers never raise."""
+def _read_jsonl_one(path: str) -> list[dict]:
     out: list[dict] = []
     try:
         f = open(path)
@@ -188,13 +205,70 @@ def read_jsonl(path: str) -> list[dict]:
     return out
 
 
+def read_jsonl(path: str) -> list[dict]:
+    """Every parseable dict record of a rolling JSONL log, in order — the
+    one tolerant reader the rolling logs share (runs.jsonl access-log
+    checks in chaos/invariants.py, health verdicts, bench_compare's
+    trajectory load).  Torn lines (a crash or a concurrent append
+    mid-write) and non-dict records are skipped; a missing file reads as
+    empty — log readers never raise.
+
+    The retained rotation generation (``<path>.1``, the writer's
+    :func:`rotate_if_over`) is read FIRST so a log that rotated mid-drill
+    still reads as one continuous history — without this, a rotation
+    would silently sever bench_compare's regression baselines and the
+    invariant checkers' access-log coverage."""
+    return _read_jsonl_one(path + ".1") + _read_jsonl_one(path)
+
+
+# rotate_if_over's per-path stat is amortized: the size check runs on the
+# first append to a path and then every _ROTATE_EVERY appends — at 64 MiB
+# default cap, a between-checks overshoot of a few records is noise, and
+# the serving hot path (several span lines per answered request) stops
+# paying a stat syscall per line.
+_ROTATE_EVERY = 16
+_rotate_counts: dict[str, int] = {}
+
+
+def rotate_if_over(path: str, max_bytes: int | None = None) -> bool:
+    """Rotate ``path`` to ``path + ".1"`` when it exceeds the size cap
+    (``$BLOCKSIM_LOG_MAX_BYTES``, default 64 MiB; 0 disables).  One
+    rotated generation is kept — these are rolling observability logs,
+    and every reader (:func:`read_jsonl`, health.latest_verdict, the
+    invariant checkers) is already tolerant of a log that begins
+    mid-history.  Returns True when a rotation happened; failures are
+    swallowed like every other write in this module."""
+    if max_bytes is None:
+        try:
+            max_bytes = int(os.environ.get(LOG_MAX_ENV,
+                                           LOG_MAX_BYTES_DEFAULT))
+        except ValueError:
+            max_bytes = LOG_MAX_BYTES_DEFAULT
+    if max_bytes <= 0:
+        return False
+    try:
+        if os.path.getsize(path) <= max_bytes:
+            return False
+        os.replace(path, path + ".1")
+        return True
+    except OSError:
+        return False
+
+
 def append_jsonl(record: dict, path: str | None = None) -> None:
     """Append one JSON line; path defaults to $BLOCKSIM_RUNS_JSONL (no-op
-    when neither is set).  Append failures are swallowed: observability must
+    when neither is set).  The shared rolling-log writer — runs.jsonl,
+    HEALTH.jsonl and the telemetry span log all come through here — so
+    the size-capped rotation (:func:`rotate_if_over`) bounds all of them
+    in one place.  Append failures are swallowed: observability must
     never take down the run it observes."""
     path = path or os.environ.get(RUNS_ENV)
     if not path:
         return
+    n = _rotate_counts.get(path, 0)
+    if n % _ROTATE_EVERY == 0:
+        rotate_if_over(path)
+    _rotate_counts[path] = n + 1
     try:
         with open(path, "a") as f:
             f.write(json.dumps(record) + "\n")

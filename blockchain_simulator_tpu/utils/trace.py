@@ -366,6 +366,53 @@ def events_from_series(series: dict, name: str) -> np.ndarray:
 MAX_COUNTER_SAMPLES = 2000
 
 
+def chrome_events(series: dict, name: str = "sim", pid: int = 0,
+                  ) -> list[dict]:
+    """The Chrome-trace event list of one probe series dict — the body of
+    :func:`to_chrome_trace`, exposed so utils/telemetry.py can overlay a
+    sim series and serving spans on ONE timeline
+    (``telemetry.spans_to_chrome_trace(series=...)``).  ``pid`` namespaces
+    the process row so the overlay's span process stays separate."""
+    ts_map = np.asarray(series["t"]) if "t" in series else None
+    events: list[dict] = [
+        {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": name}},
+    ]
+    tid = 0
+    for k in sorted(series):
+        if k == "t":
+            continue
+        v = np.asarray(series[k])
+        if v.ndim != 1 or v.size == 0:
+            continue
+        t_axis = (
+            ts_map
+            if ts_map is not None and len(ts_map) == len(v)
+            else np.arange(len(v))
+        )
+        tid += 1
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": k},
+        })
+        stride = max(1, len(v) // MAX_COUNTER_SAMPLES)
+        for i in range(0, len(v), stride):
+            events.append({
+                "name": k, "ph": "C", "pid": pid, "tid": 0,
+                "ts": int(t_axis[i]) * 1000,
+                "args": {k: float(v[i])},
+            })
+        d = np.diff(v.astype(np.int64), prepend=0)
+        if np.all(d >= 0):  # monotone counter: increments are events
+            for i in np.flatnonzero(d > 0):
+                events.append({
+                    "name": k, "ph": "i", "s": "t", "pid": pid, "tid": tid,
+                    "ts": int(t_axis[i]) * 1000,
+                    "args": {"value": int(v[i]), "delta": int(d[i])},
+                })
+    return events
+
+
 def to_chrome_trace(series: dict, path, name: str = "sim") -> dict:
     """Convert a probe series dict to a Chrome-trace JSON timeline.
 
@@ -382,45 +429,8 @@ def to_chrome_trace(series: dict, path, name: str = "sim") -> dict:
     sample index as the tick.  Returns ``{"events", "instants", "path"}``
     (counts, for callers that report them).
     """
-    ts_map = np.asarray(series["t"]) if "t" in series else None
-    events: list[dict] = [
-        {"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
-         "args": {"name": name}},
-    ]
-    n_instant = 0
-    tid = 0
-    for k in sorted(series):
-        if k == "t":
-            continue
-        v = np.asarray(series[k])
-        if v.ndim != 1 or v.size == 0:
-            continue
-        t_axis = (
-            ts_map
-            if ts_map is not None and len(ts_map) == len(v)
-            else np.arange(len(v))
-        )
-        tid += 1
-        events.append({
-            "name": "thread_name", "ph": "M", "pid": 0, "tid": tid,
-            "args": {"name": k},
-        })
-        stride = max(1, len(v) // MAX_COUNTER_SAMPLES)
-        for i in range(0, len(v), stride):
-            events.append({
-                "name": k, "ph": "C", "pid": 0, "tid": 0,
-                "ts": int(t_axis[i]) * 1000,
-                "args": {k: float(v[i])},
-            })
-        d = np.diff(v.astype(np.int64), prepend=0)
-        if np.all(d >= 0):  # monotone counter: increments are events
-            for i in np.flatnonzero(d > 0):
-                events.append({
-                    "name": k, "ph": "i", "s": "t", "pid": 0, "tid": tid,
-                    "ts": int(t_axis[i]) * 1000,
-                    "args": {"value": int(v[i]), "delta": int(d[i])},
-                })
-                n_instant += 1
+    events = chrome_events(series, name=name, pid=0)
+    n_instant = sum(1 for e in events if e.get("ph") == "i")
     doc = {"traceEvents": events, "displayTimeUnit": "ms"}
     with open(path, "w") as f:
         json.dump(doc, f)

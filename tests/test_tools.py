@@ -244,6 +244,25 @@ def test_bench_compare_never_gates_journal_resume_series(tmp_path):
     assert "resume_recomputed_chunks" in proc.stdout
 
 
+def test_bench_compare_never_gates_telemetry_series(tmp_path):
+    """The telemetry report series (telemetry_ prefix, tools/
+    telemetry_report.py) is charted only: span-miss counts and coverage/
+    overhead percentages are gated by the report's own exit code — a
+    coverage drop must never trip the generic throughput rule."""
+    runs = tmp_path / "runs.jsonl"
+    rows = []
+    for metric, vals in (("telemetry_span_miss", (3, 0)),
+                         ("telemetry_coverage_pct", (99.9, 10.0)),
+                         ("telemetry_overhead_pct", (4.0, 0.5))):
+        rows += [{"metric": metric, "value": v,
+                  "manifest": {"obs_schema": 1}} for v in vals]
+    runs.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    proc = _run([str(BENCH_COMPARE), _bench_artifact(tmp_path, 1, 100.0),
+                 "--runs", str(runs)])
+    assert proc.returncode == 0, proc.stdout
+    assert "telemetry_coverage_pct" in proc.stdout
+
+
 def test_bench_compare_gates_p99_latency_inverted(tmp_path):
     """serve_p99_ms is lower-is-better AND gated: an increase beyond the
     threshold is the regression; a decrease (faster serving) never trips."""
@@ -362,9 +381,12 @@ def test_lint_sh_chains_both_gates(tmp_path):
         # pin) and the slow CLI test.
         # TICK=0: the tick-bench smoke compiles three dispatch arms —
         # covered by tests/test_ztick.py (bit-equality + executable pins).
+        # TELEM=0: the telemetry report drives a warm in-process fleet —
+        # covered by tests/test_zztelemetry.py (gates + slow CLI test).
         env={**os.environ, "BLOCKSIM_RUNS_JSONL": str(runs),
              "WARM_BENCH": "0", "GRAPH": "0", "SERVE": "0", "CHAOS": "0",
-             "MESH_SWEEP": "0", "FLEET": "0", "RESUME": "0", "TICK": "0"},
+             "MESH_SWEEP": "0", "FLEET": "0", "RESUME": "0", "TICK": "0",
+             "TELEM": "0"},
     )
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
     assert "jaxlint" in proc.stdout and "no regression" in proc.stdout
@@ -385,6 +407,8 @@ def test_lint_sh_chains_both_gates(tmp_path):
     assert '"${RESUME:-1}"' in script
     assert "tools/tick_bench.py --quick" in script
     assert '"${TICK:-1}"' in script
+    assert "tools/telemetry_report.py --quick" in script
+    assert '"${TELEM:-1}"' in script
     recs = [json.loads(ln) for ln in runs.read_text().strip().splitlines()]
     lint_recs = [r for r in recs if r.get("metric") == "jaxlint_new_findings"]
     assert lint_recs and lint_recs[-1]["value"] == 0

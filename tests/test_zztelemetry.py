@@ -1,0 +1,443 @@
+"""Telemetry core (utils/telemetry.py) + its serving/sweep/chaos wiring.
+
+Late-alphabet name per the tier-1 window rule (ROADMAP): the whole-stack
+drills here compile serve executables and must not displace the early
+suite inside the timeout window.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from blockchain_simulator_tpu.chaos import invariants
+from blockchain_simulator_tpu.utils import obs, telemetry
+
+TPL = {"protocol": "pbft", "n": 8, "sim_ms": 200, "stat_sampler": "exact"}
+
+
+# ------------------------------------------------------------ ids/context
+
+
+def test_trace_header_round_trip():
+    ctx = telemetry.TraceContext(telemetry.new_trace_id(),
+                                 telemetry.new_span_id())
+    assert telemetry.parse_header(ctx.header()) == ctx
+    # garbage never rejects a request — it reads as "no trace"
+    for bad in (None, "", "nope", "xyz:", ":abc", "g!:12", 7):
+        assert telemetry.parse_header(bad) is None
+
+
+def test_span_nesting_parents_and_tls_restore():
+    with telemetry.capture() as buf:
+        assert telemetry.current() is None
+        with telemetry.span("outer", a=1) as octx:
+            assert telemetry.current() == octx
+            with telemetry.span("inner") as ictx:
+                assert telemetry.current() == ictx
+        assert telemetry.current() is None
+    inner, outer = buf
+    assert inner["name"] == "inner" and outer["name"] == "outer"
+    assert inner["parent"] == octx.span_id
+    assert inner["trace"] == outer["trace"] == octx.trace_id
+    assert outer["attrs"] == {"a": 1}
+
+
+def test_span_error_status_and_reraise():
+    with telemetry.capture() as buf:
+        with pytest.raises(ValueError):
+            with telemetry.span("boom"):
+                raise ValueError("x")
+    assert buf[0]["status"] == "error"
+
+
+def test_span_log_file_armed_by_env(tmp_path, monkeypatch):
+    path = tmp_path / "spans.jsonl"
+    monkeypatch.setenv(telemetry.SPANS_ENV, str(path))
+    telemetry.emit("probe.span", 0.0, 0.001, note="hi")
+    recs = obs.read_jsonl(str(path))
+    assert len(recs) == 1 and recs[0]["name"] == "probe.span"
+    monkeypatch.delenv(telemetry.SPANS_ENV)
+    telemetry.emit("probe.span2", 0.0, 0.001)
+    assert len(obs.read_jsonl(str(path))) == 1  # disarmed = no write
+
+
+# ---------------------------------------------------------------- metrics
+
+
+def test_metrics_registry_counter_gauge_histogram_and_exposition():
+    reg = telemetry.MetricsRegistry()
+    c = reg.counter("x_total", kind="a")
+    assert reg.counter("x_total", kind="a") is c  # get-or-create identity
+    c.inc()
+    c.inc(2)
+    reg.gauge("g").set(7)
+    h = reg.histogram("lat_ms")
+    for v in (3, 7, 40, 900):
+        h.observe(v)
+    expo = reg.exposition()
+    assert "# TYPE x_total counter" in expo
+    assert 'x_total{kind="a"} 3' in expo
+    assert "# TYPE lat_ms histogram" in expo
+    assert 'lat_ms_bucket{le="5"} 1' in expo        # cumulative
+    assert 'lat_ms_bucket{le="+Inf"} 4' in expo
+    assert "lat_ms_count 4" in expo and "lat_ms_sum 950" in expo
+    snap = reg.snapshot()
+    assert snap["counters"]['x_total{kind="a"}'] == 3
+    assert snap["histograms"]["lat_ms"]["count"] == 4
+
+
+def test_histogram_percentiles_bucket_resolution():
+    h = telemetry.Histogram("h", {}, threading.Lock())
+    assert h.percentile(99) == 0.0  # empty
+    for v in (3, 7, 40, 900):
+        h.observe(v)
+    # rank-2 of 4 at q=50 falls in the le=10 bucket
+    assert h.percentile(50) == 10.0
+    # the +Inf tail answers the max observed, never infinity
+    h2 = telemetry.Histogram("h2", {}, threading.Lock(), bounds=(1.0,))
+    h2.observe(123456.0)
+    assert h2.percentile(99) == 123456.0
+    assert set(h.percentiles()) == {"p50", "p95", "p99"}
+
+
+# --------------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_ring_bounded_and_dump(tmp_path, monkeypatch):
+    fr = telemetry.FlightRecorder(capacity=4)
+    for i in range(10):
+        fr.note("e", i=i)
+    snap = fr.snapshot()
+    assert len(snap) == 4 and [r["i"] for r in snap] == [6, 7, 8, 9]
+    # disarmed: no env, no path -> no file, returns None
+    assert fr.dump("test") is None
+    out = tmp_path / "flight.json"
+    assert fr.dump("test", str(out)) == str(out)
+    doc = json.loads(out.read_text())
+    assert doc["reason"] == "test" and len(doc["records"]) == 4
+    assert "metrics" in doc
+    # env arms the directory form
+    monkeypatch.setenv(telemetry.FLIGHT_ENV, str(tmp_path))
+    path = fr.dump("shutdown")
+    assert path and os.path.exists(path) and "shutdown" in path
+
+
+def test_profile_region_disarmed_is_free(monkeypatch):
+    monkeypatch.delenv(telemetry.PROFILE_ENV, raising=False)
+    with telemetry.profile_region("x"):
+        ran = True
+    assert ran
+
+
+# ----------------------------------------------------------- log rotation
+
+
+def test_append_jsonl_rotates_at_size_cap(tmp_path, monkeypatch):
+    path = tmp_path / "runs.jsonl"
+    monkeypatch.setenv(obs.LOG_MAX_ENV, "200")
+    # the size check is amortized (obs._ROTATE_EVERY appends between
+    # stats), so write enough records to cross a check boundary well
+    # past the cap
+    for i in range(10 * obs._ROTATE_EVERY):
+        obs.append_jsonl({"i": i, "pad": "x" * 20}, str(path))
+    assert os.path.exists(str(path) + ".1")  # rotated generation
+    assert os.path.getsize(str(path)) < 200 + 40 * obs._ROTATE_EVERY
+    # the shared reader stitches the retained generation in front of the
+    # live file, so a mid-drill rotation never severs a reader's history
+    live = obs.read_jsonl(str(path))
+    old = obs._read_jsonl_one(str(path) + ".1")
+    assert old and live[-1]["i"] == 10 * obs._ROTATE_EVERY - 1
+    assert len(live) > len(obs._read_jsonl_one(str(path)))
+    # in-order across the generation seam
+    idx = [r["i"] for r in live]
+    assert idx == sorted(idx)
+    # cap 0 disables rotation
+    monkeypatch.setenv(obs.LOG_MAX_ENV, "0")
+    before = os.path.getmtime(str(path) + ".1")
+    for i in range(2 * obs._ROTATE_EVERY):
+        obs.append_jsonl({"i": i, "pad": "x" * 20}, str(path))
+    assert os.path.getmtime(str(path) + ".1") == before
+    assert obs.rotate_if_over(str(path), max_bytes=0) is False
+
+
+# -------------------------------------------------------- serving wiring
+
+
+def test_server_emits_request_span_tree_and_latency_stats():
+    from blockchain_simulator_tpu.serve import ScenarioServer
+
+    with telemetry.capture() as spans:
+        with ScenarioServer(max_batch=2, max_wait_ms=50.0) as srv:
+            a = srv.submit(dict(TPL, seed=1, id="t1"))
+            b = srv.submit(dict(TPL, seed=2, id="t2",
+                                faults={"n_byzantine": 1}))
+            ra, rb = a.result(300), b.result(300)
+            stats = srv.stats()
+    assert ra["status"] == "ok" and rb["status"] == "ok"
+    roots = [s for s in spans if s["name"] == "serve.request"]
+    assert {s["attrs"]["id"] for s in roots} == {"t1", "t2"}
+    for root in roots:
+        kids = [s for s in spans if s.get("parent") == root["id"]
+                and s["trace"] == root["trace"]]
+        names = {s["name"] for s in kids}
+        assert {"serve.admit", "serve.queue_wait", "serve.batch_wait",
+                "serve.dispatch", "serve.answer"} <= names
+        # the segments tile the request: leaf wall ~== root wall
+        leaf = sum(s["dur_ms"] for s in kids)
+        assert leaf <= root["dur_ms"] * 1.05
+        assert leaf >= root["dur_ms"] * 0.90
+        disp = next(s for s in kids if s["name"] == "serve.dispatch")
+        assert disp["attrs"]["bucket"] == 2  # pad-bucket provenance
+    # /stats latency percentiles from the histograms (satellite 1)
+    lat = stats["latency_ms"]
+    assert set(lat) == {"request", "queue_wait", "batch_wait", "dispatch"}
+    assert lat["request"]["p50"] >= lat["dispatch"]["p50"] > 0
+
+
+def test_server_rejection_spans_and_counter_reconciliation():
+    from blockchain_simulator_tpu.serve import ScenarioServer, ServeError
+
+    before = telemetry.metrics.snapshot()
+    with telemetry.capture() as spans:
+        srv = ScenarioServer(max_batch=2, max_wait_ms=5.0, max_queue=1,
+                             start=False)
+        srv.submit(dict(TPL, seed=2, id="q-ok"))
+        with pytest.raises(ServeError):
+            srv.submit(dict(TPL, seed=3, id="q-over"))  # queue-full
+        srv.start()
+        srv.close()
+    after = telemetry.metrics.snapshot()
+    roots = {s["attrs"]["id"]: s for s in spans
+             if s["name"] == "serve.request"}
+    assert roots["q-over"]["status"] == "error"
+    assert roots["q-over"]["attrs"]["outcome"] == "queue-full"
+    # conservation holds across admit/reject/serve (satellite 3)
+    assert invariants.check_telemetry(before, after) == []
+
+
+def test_http_daemon_propagates_trace_header_and_serves_metrics():
+    import urllib.request
+
+    from blockchain_simulator_tpu.serve.__main__ import make_httpd
+    from blockchain_simulator_tpu.serve.server import ScenarioServer
+
+    server = ScenarioServer(max_batch=2, max_wait_ms=10.0)
+    httpd = make_httpd(server, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        ctx = telemetry.TraceContext("ab" * 8, "cd" * 4)
+        req = urllib.request.Request(
+            f"{base}/scenario",
+            data=json.dumps(dict(TPL, seed=5, id="hdr-1")).encode(),
+            headers={"Content-Type": "application/json",
+                     telemetry.TRACE_HEADER: ctx.header()},
+        )
+        with telemetry.capture() as spans:
+            with urllib.request.urlopen(req, timeout=300) as r:
+                body = json.loads(r.read())
+        assert body["status"] == "ok"
+        root = next(s for s in spans if s["name"] == "serve.request")
+        # the replica's tree hangs off the router's send span
+        assert root["trace"] == ctx.trace_id
+        assert root["parent"] == ctx.span_id
+        # /metrics: Prometheus text exposition
+        with urllib.request.urlopen(f"{base}/metrics", timeout=60) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            expo = r.read().decode()
+        assert "blocksim_serve_request_ms_bucket" in expo
+        assert "blocksim_serve_received_total" in expo
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        server.close()
+
+
+def test_access_log_lines_carry_trace_id(tmp_path, monkeypatch):
+    from blockchain_simulator_tpu.serve import ScenarioServer
+
+    log = tmp_path / "access.jsonl"
+    monkeypatch.setenv(obs.RUNS_ENV, str(log))
+    with ScenarioServer(max_batch=1, max_wait_ms=5.0) as srv:
+        r = srv.request(dict(TPL, seed=9, id="logged-1"), wait_s=300)
+    assert r["status"] == "ok"
+    assert "trace" not in r  # responses stay trace-free (determinism)
+    recs = [x for x in obs.read_jsonl(str(log))
+            if x.get("id") == "logged-1"]
+    assert recs and isinstance(recs[0].get("trace"), str)
+
+
+def test_router_trace_tree_spans_fleet_and_stats_percentiles():
+    from blockchain_simulator_tpu.chaos.fleet_scenarios import LocalReplica
+    from blockchain_simulator_tpu.serve.router import FleetRouter
+
+    rep = LocalReplica("tele-rep", max_batch=2, max_wait_ms=10.0)
+    try:
+        with telemetry.capture() as spans:
+            router = FleetRouter([rep], probe=False)
+            try:
+                resp = router.request(dict(TPL, seed=21, id="fl-1"),
+                                      wait_s=300)
+                stats = router.stats()
+            finally:
+                router.close()
+        assert resp["status"] == "ok"
+        root = next(s for s in spans if s["name"] == "router.request")
+        send = next(s for s in spans if s["name"] == "router.send")
+        serve_root = next(s for s in spans if s["name"] == "serve.request")
+        assert send["parent"] == root["id"]
+        assert serve_root["trace"] == root["trace"]
+        assert serve_root["parent"] == send["id"]
+        assert serve_root["attrs"].get("replica") == "tele-rep"
+        assert stats["latency_ms"]["request"]["p99"] > 0
+    finally:
+        rep.close()
+
+
+# ------------------------------------------------------------ sweep wiring
+
+
+def test_journaled_sweep_emits_chunk_spans(tmp_path):
+    from blockchain_simulator_tpu.models.base import canonical_fault_cfg
+    from blockchain_simulator_tpu.parallel.journal import SweepJournal
+    from blockchain_simulator_tpu.parallel.sweep import run_dyn_points
+    from blockchain_simulator_tpu.utils.config import SimConfig
+
+    cfg = SimConfig(protocol="pbft", n=8, sim_ms=200, stat_sampler="exact")
+    canon = canonical_fault_cfg(cfg)
+    journal = SweepJournal(str(tmp_path / "sweep.jsonl"))
+    points = [(cfg, 0), (cfg, 1), (cfg, 2), (cfg, 3)]
+    with telemetry.capture() as spans:
+        run_dyn_points(canon, points, record=False, journal=journal,
+                       chunk_size=2)
+    chunk_spans = [s for s in spans if s["name"] == "sweep.chunk"]
+    assert len(chunk_spans) == 2
+    assert {s["attrs"]["index"] for s in chunk_spans} == {0, 1}
+    assert all(s["attrs"]["arm"] == "primary" for s in chunk_spans)
+    # resumed chunks are reads, not dispatches: no new chunk spans
+    with telemetry.capture() as spans2:
+        run_dyn_points(canon, points, record=False,
+                       journal=SweepJournal(str(tmp_path / "sweep.jsonl")),
+                       chunk_size=2)
+    assert [s for s in spans2 if s["name"] == "sweep.chunk"] == []
+
+
+def test_supervisor_degrade_notes_flight_recorder():
+    from blockchain_simulator_tpu.parallel import journal as journal_mod
+
+    sup = journal_mod.ChunkSupervisor(deadline_s=None, retries=0,
+                                      backoff_s=0.0)
+    telemetry.flight.reset()
+
+    def primary():
+        raise RuntimeError("primary down")
+
+    rows, events = journal_mod.run_supervised(primary, lambda: ["row"],
+                                              sup, key="k1")
+    assert rows == ["row"] and "degrade" in events
+    kinds = [r.get("event") for r in telemetry.flight.snapshot()
+             if r.get("kind") == "event"]
+    assert "sweep.error" in kinds and "sweep.degrade" in kinds
+
+
+# ----------------------------------------------------- determinism / rules
+
+
+def test_same_drill_twice_normalizes_to_equal_span_trees():
+    from blockchain_simulator_tpu.serve import ScenarioServer
+
+    def run_once():
+        with telemetry.capture() as spans:
+            with ScenarioServer(max_batch=2, max_wait_ms=100.0) as srv:
+                p1 = srv.submit(dict(TPL, seed=4, id="d1"))
+                p2 = srv.submit(dict(TPL, seed=5, id="d2",
+                                     faults={"n_byzantine": 1}))
+                p1.result(300), p2.result(300)
+        return invariants.normalize_spans(spans)
+
+    assert run_once() == run_once()
+
+
+def test_normalize_spans_excludes_sweep_and_strips_timing():
+    spans = [
+        {"kind": "span", "name": "sweep.chunk", "trace": "t", "id": "a",
+         "parent": None, "dur_ms": 5, "status": "ok"},
+        {"kind": "span", "name": "serve.request", "trace": "t2", "id": "b",
+         "parent": None, "dur_ms": 17.3, "status": "ok",
+         "attrs": {"id": "r1", "outcome": "served", "size": 3}},
+    ]
+    norm = invariants.normalize_spans(spans)
+    assert norm == ["serve.request[id=r1;outcome=served]~ok"]
+
+
+def test_no_telemetry_call_site_in_traced_code():
+    """The host-side-only rule (ISSUE 14 satellite): traced code — the
+    models and ops packages, whose functions run under jit/vmap/scan —
+    must never touch utils/telemetry.py; spans and counters are host
+    syncs.  Source-level pin, the telemetry corollary of the jaxlint
+    host-sync-in-traced rule."""
+    import blockchain_simulator_tpu
+
+    pkg = os.path.dirname(blockchain_simulator_tpu.__file__)
+    for sub in ("models", "ops"):
+        for root, _dirs, files in os.walk(os.path.join(pkg, sub)):
+            for fname in files:
+                if not fname.endswith(".py"):
+                    continue
+                src = open(os.path.join(root, fname)).read()
+                assert "telemetry" not in src, (
+                    f"{sub}/{fname} references telemetry — traced code "
+                    "is host-side-telemetry-free by rule")
+
+
+def test_spans_to_chrome_trace_merges_series(tmp_path):
+    import numpy as np
+
+    spans = [
+        {"kind": "span", "name": "serve.request", "trace": "t1",
+         "id": "aa", "parent": None, "ts": 100.0, "dur_ms": 12.5,
+         "status": "ok", "attrs": {"id": "r1"}},
+        {"kind": "span", "name": "serve.dispatch", "trace": "t1",
+         "id": "bb", "parent": "aa", "ts": 100.002, "dur_ms": 9.0,
+         "status": "ok"},
+    ]
+    series = {"commits": np.asarray([0, 1, 2, 2])}
+    out = tmp_path / "trace.json"
+    rec = telemetry.spans_to_chrome_trace(spans, str(out), series=series)
+    doc = json.loads(out.read_text())
+    evs = doc["traceEvents"]
+    xs = [e for e in evs if e.get("ph") == "X"]
+    assert {e["name"] for e in xs} == {"serve.request", "serve.dispatch"}
+    # both rows of one trace share a tid; the series rides pid 0
+    assert len({e["tid"] for e in xs}) == 1
+    assert any(e.get("ph") == "C" and e["pid"] == 0 for e in evs)
+    assert any(e.get("ph") == "i" for e in evs)  # commit instants
+    assert rec["events"] == len(evs)
+
+
+def test_telemetry_report_quick_cli(tmp_path):
+    """Slow-marked end-to-end: the lint.sh-chained gate itself."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = tmp_path / "ARTIFACT_telemetry.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "telemetry_report.py"),
+         "--quick", "--out", str(out)],
+        capture_output=True, text=True, timeout=900, cwd=repo,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-1000:]
+    doc = json.loads(out.read_text())
+    assert doc["ok"] is True
+    assert doc["completeness"]["misses"] == []
+    assert doc["coverage"]["best_pct"] >= 95.0
+
+
+test_telemetry_report_quick_cli = pytest.mark.slow(
+    test_telemetry_report_quick_cli)

@@ -135,8 +135,12 @@ def trajectory(rows: list[dict]) -> dict[str, list[dict]]:
 # overhead pct and recompute counts are lower-is-better with their own
 # drill/bench exit codes, and a resume replaying MORE rows from the
 # journal means a fuller journal, not a regression — chart, never gate.
+# The telemetry series ("telemetry_*" from tools/telemetry_report.py —
+# span-completeness misses, wall-time coverage pct, overhead pct) follow
+# the same rule: the report's own gates are its exit code.
 UNGATED_SUFFIXES = ("_findings", "_compile_s", "_p50_ms")
-UNGATED_PREFIXES = ("graph_", "chaos_", "fleet_", "journal_", "resume_")
+UNGATED_PREFIXES = ("graph_", "chaos_", "fleet_", "journal_", "resume_",
+                    "telemetry_")
 
 # Serving latency is lower-is-better AND gated: the serve smoke/bench land
 # a p99 trajectory (serve_p99_ms) whose REGRESSION is an increase, so the

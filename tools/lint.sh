@@ -168,6 +168,24 @@ if [ "${TICK:-1}" != "0" ]; then
     fi
 fi
 
+# Telemetry report (tools/telemetry_report.py --quick): a real in-process
+# fleet drill (router -> replica -> batcher -> dispatch) with spans
+# captured — every admitted id must have a closed span tree and the named
+# segments must cover >= 95% of one request's wall (utils/telemetry.py);
+# lands telemetry_span_miss / telemetry_coverage_pct in runs.jsonl
+# (charted, never gated by bench_compare — the report's own exit code is
+# the gate).  TELEM=0 skips (~30 s warm on this box); the full run adds
+# the serve_bench overhead leg and writes ARTIFACT_telemetry.json.
+if [ "${TELEM:-1}" != "0" ]; then
+    echo "== telemetry report =="
+    python tools/telemetry_report.py --quick
+    telem_rc=$?
+    if [ "$telem_rc" -ne 0 ]; then
+        echo "lint.sh: telemetry report FAILED (rc=$telem_rc)" >&2
+        rc=1
+    fi
+fi
+
 echo "== bench_compare =="
 if [ -n "${BLOCKSIM_RUNS_JSONL:-}" ] && [ -f "${BLOCKSIM_RUNS_JSONL}" ]; then
     python tools/bench_compare.py --runs "${BLOCKSIM_RUNS_JSONL}" "$@"

@@ -193,10 +193,33 @@ def _degraded_rounds(remaining_s: float, prev, prev_rounds: int, want: int):
     return None
 
 
+def _topo_kw() -> dict:
+    """Topology axis pass-through (topo/): BENCH_TOPOLOGY selects the
+    member (full/dense, gossip, kregular, committee), BENCH_DEGREE /
+    BENCH_COMMITTEES size it.  Defaults keep the historical full-mesh
+    headline; non-full topologies force the tick engine (the fast paths
+    are full-mesh aggregates — runner.use_round_schedule), so a topology
+    bench measures the general engine's sparse envelope, same as
+    tools/topo_bench.py's ladder."""
+    topo = os.environ.get("BENCH_TOPOLOGY", "full")
+    kw: dict = {"topology": topo}
+    if topo in ("gossip", "kregular"):
+        kw["degree"] = int(os.environ.get("BENCH_DEGREE", "8"))
+        kw["fidelity"] = "clean"
+    if topo == "gossip":
+        # gossip requires the exact vote table (a multi-hop PRE_PREPARE can
+        # trail its slot's direct votes past a window re-tenancy —
+        # models/pbft.py init); override _cfg's windowed default
+        kw["pbft_window"] = 0
+    if topo == "committee":
+        kw["committees"] = int(os.environ.get("BENCH_COMMITTEES", "100"))
+    return kw
+
+
 def _cfg(rounds: int):
     from blockchain_simulator_tpu.utils.config import SimConfig
 
-    return SimConfig(
+    kw = dict(
         protocol="pbft",
         n=N_NODES,
         # `rounds` rounds at 50 ms plus the commit tail — no idle coda
@@ -214,6 +237,8 @@ def _cfg(rounds: int):
         # config below covers the constant-serialization model).
         model_serialization=False,
     )
+    kw.update(_topo_kw())  # topology overrides win (gossip: exact table)
+    return SimConfig(**kw)
 
 
 def _cfg_ser(rounds: int):

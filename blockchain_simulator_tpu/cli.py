@@ -69,12 +69,28 @@ def build_parser() -> argparse.ArgumentParser:
                    help="model per-message block serialization time "
                         "(bytes*8/link_rate; the reference's dominant "
                         "timing term) in addition to propagation delay")
-    # topology (BASELINE config 3: gossip instead of full mesh)
-    p.add_argument("--topology", choices=["full", "kregular"], default=d.topology)
+    # topology axis (topo/): full mesh, gossip flood (BASELINE config 3),
+    # kregular gather overlay, or two-level committee hierarchy
+    p.add_argument("--topology",
+                   choices=["full", "dense", "gossip", "kregular", "committee"],
+                   default=d.topology,
+                   help="full/dense = reference full mesh; gossip = TTL "
+                        "flood over a random k-out digraph; kregular = "
+                        "fixed-degree circulant overlay with gather-based "
+                        "direct delivery (O(N*k) memory; bit-equal to the "
+                        "mesh at --degree n-1); committee = the flat "
+                        "protocol inside each of --committees committees "
+                        "plus an outer representative aggregate")
     p.add_argument("--degree", type=int, default=d.degree,
-                   help="gossip out-degree (kregular)")
+                   help="out-degree k (gossip flood fan-out / kregular "
+                        "overlay degree)")
     p.add_argument("--gossip-hops", type=int, default=d.gossip_hops,
-                   help="flood TTL (kregular)")
+                   help="flood TTL (gossip)")
+    p.add_argument("--committees", type=int, default=d.committees,
+                   help="committee count (topology=committee; must divide n)")
+    p.add_argument("--topo-seed", type=int, default=d.topo_seed,
+                   help="kregular overlay-builder seed (separate from the "
+                        "run seed so sweeps share one overlay/executable)")
     p.add_argument("--paxos-timeout-ms", type=int, default=d.paxos_retry_timeout_ms,
                    help="clean-fidelity retry window timeout")
     p.add_argument("--paxos-client", nargs=2, type=int, default=None,
@@ -159,6 +175,8 @@ def config_from_args(args) -> SimConfig:
         topology=args.topology,
         degree=args.degree,
         gossip_hops=args.gossip_hops,
+        committees=args.committees,
+        topo_seed=args.topo_seed,
         paxos_retry_timeout_ms=args.paxos_timeout_ms,
         paxos_client_node=args.paxos_client[0] if args.paxos_client else -1,
         paxos_client_ms=args.paxos_client[1] if args.paxos_client else 0,

@@ -158,6 +158,30 @@ def audit_configs() -> dict[str, "object"]:
         "mixed_tick": SimConfig(protocol="mixed", n=8, mixed_shards=2,
                                 sim_ms=200, schedule="tick",
                                 stat_sampler="exact"),
+        # topology axis (topo/): kregular gather overlays — edge and stat
+        # delivery — and the two-level committee hierarchy.  Degree 3 keeps
+        # K = 4 < N = 8 so the traced gathers are REAL sparse gathers, not
+        # the identity full-overlay case the bit-equality tests pin.
+        "pbft_kreg": SimConfig(protocol="pbft", n=8, sim_ms=200,
+                               fidelity="clean", topology="kregular",
+                               degree=3, stat_sampler="exact"),
+        "pbft_kreg_stat": SimConfig(protocol="pbft", n=8, sim_ms=200,
+                                    fidelity="clean", topology="kregular",
+                                    degree=3, delivery="stat",
+                                    stat_sampler="exact"),
+        "raft_kreg": SimConfig(protocol="raft", n=8, sim_ms=200,
+                               fidelity="clean", topology="kregular",
+                               degree=3, stat_sampler="exact"),
+        "raft_kreg_stat": SimConfig(protocol="raft", n=8, sim_ms=200,
+                                    fidelity="clean", topology="kregular",
+                                    degree=3, delivery="stat",
+                                    stat_sampler="exact"),
+        "paxos_kreg": SimConfig(protocol="paxos", n=8, sim_ms=200,
+                                fidelity="clean", topology="kregular",
+                                degree=3, stat_sampler="exact"),
+        "pbft_comm": SimConfig(protocol="pbft", n=8, sim_ms=200,
+                               topology="committee", committees=2,
+                               stat_sampler="exact"),
         # fast paths, explicitly scheduled (eligibility asserted in tests)
         "pbft_round": SimConfig(protocol="pbft", n=8, sim_ms=200,
                                 delivery="stat", schedule="round",
@@ -199,7 +223,14 @@ def build_catalog() -> list[ProgramSpec]:
         return ProgramSpec(f"sim.{arm}", "sim", build)
 
     for arm in ("pbft_tick", "pbft_round", "raft_tick", "raft_hb",
-                "paxos_tick", "mixed_tick", "mixed_fast"):
+                "paxos_tick", "mixed_tick", "mixed_fast",
+                # the topology axis: every gather-overlay arm (edge + stat
+                # per protocol) and the committee lax.map body — the new
+                # programs must come in budgeted, and their gather bodies
+                # scatter-free beyond the dense engines' baselined [W]-fold
+                # accumulators (tests/test_zztopo.py counts them)
+                "pbft_kreg", "pbft_kreg_stat", "raft_kreg",
+                "raft_kreg_stat", "paxos_kreg", "pbft_comm"):
         specs.append(sim_spec(arm))
 
     # --- runner.make_segment_fn ("segment") -----------------------------
@@ -263,6 +294,18 @@ def build_catalog() -> list[ProgramSpec]:
                            {"n_crashed": 1}, "dynf:raft_tick", True))
     specs.append(dynf_spec("sweep_dynf.raft_c2", "raft_tick",
                            {"n_crashed": 2}, "dynf:raft_tick", False))
+    # topology-axis twins: ONE executable per (protocol, topology, fault
+    # structure) — fault counts over one kregular overlay / committee
+    # hierarchy must trace to one fingerprint, or topology sweeps silently
+    # recompile per fault level (the ISSUE 15 registry pin)
+    specs.append(dynf_spec("sweep_dynf.pbft_kreg", "pbft_kreg",
+                           {"n_crashed": 1}, "dynf:pbft_kreg", True))
+    specs.append(dynf_spec("sweep_dynf.pbft_kreg_c2", "pbft_kreg",
+                           {"n_crashed": 2}, "dynf:pbft_kreg", False))
+    specs.append(dynf_spec("sweep_dynf.pbft_comm", "pbft_comm",
+                           {"n_crashed": 1}, "dynf:pbft_comm", True))
+    specs.append(dynf_spec("sweep_dynf.pbft_comm_c2", "pbft_comm",
+                           {"n_crashed": 2}, "dynf:pbft_comm", False))
 
     # --- parallel/sweep.mesh_dyn_batched_fn ("partition-dyn-sweep") -----
     # The mesh-partitioned sweep executable (parallel/partition.py layer):

@@ -39,6 +39,21 @@ def get_protocol(name: str):
     return m
 
 
+def sim_metrics(cfg, final) -> dict:
+    """Host-side metrics for ONE final state, topology-aware: the committee
+    path's final is a stacked [C, ...] pytree whose metrics are the
+    two-level aggregate (topo/committee.py); every other topology is the
+    flat protocol's own surface.  The one metrics door runner, sweeps and
+    the scenario server share — call sites must not reach for
+    ``get_protocol(cfg.protocol).metrics`` directly once a topology can
+    reshape the final state."""
+    if cfg.topology == "committee":
+        from blockchain_simulator_tpu.topo import committee
+
+        return committee.metrics(cfg, final)
+    return get_protocol(cfg.protocol).metrics(cfg, final)
+
+
 def gated(pred, fn, zeros, axis=None):
     """Skip a delivery computation when no sender is active this tick.
     Sharded, the predicate must be globally agreed (the branch contains

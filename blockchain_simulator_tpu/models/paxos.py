@@ -64,7 +64,7 @@ unrolled), a deterministic stand-in for the reference's arrival-order
 processing.  Retries cap at ``paxos_max_ticket`` (the reference's single-char
 codec would corrupt beyond '0'+9 anyway, quirk #11).
 
-Gossip topology (``topology="kregular"``, BASELINE config 3): requests are not
+Gossip topology (``topology="gossip"``, BASELINE config 3): requests are not
 broadcast — they *flood* over a random k-out digraph (ops/topology.py) with a
 hop TTL.  Channel values carry ``encoded * H + hops_left`` (H = gossip_hops+1,
 so a higher ticket always dominates in the max-combine regardless of TTL); a
@@ -93,6 +93,7 @@ from blockchain_simulator_tpu.models.base import fault_masks, gated
 from blockchain_simulator_tpu.ops import delay as delay_ops
 from blockchain_simulator_tpu.ops import delivery as dv
 from blockchain_simulator_tpu.ops import topology
+from blockchain_simulator_tpu.ops import gatherdeliv as gd
 from blockchain_simulator_tpu.ops.ring import ring_pop, ring_push_add, ring_push_max
 from blockchain_simulator_tpu.utils.prng import Channel, chan_key
 
@@ -147,7 +148,7 @@ def init(cfg, key=None):
     if cfg.fidelity == "clean":
         _, rt_hi = cfg.roundtrip_range()
         horizon = rt_hi
-        if cfg.topology == "kregular":
+        if cfg.topology == "gossip":
             # an origin send with TTL=gossip_hops can traverse gossip_hops+1
             # flood legs (arrival TTLs gossip_hops..0 all processed + replied)
             # plus the direct reply leg, each up to hi-1 ms
@@ -193,17 +194,23 @@ def init(cfg, key=None):
 
 
 def _req_contrib(key, val_local, lo, hi, drop, axis, ids, p, ref_skip,
-                 impl="threefry"):
+                 impl="threefry", inmask=None):
     """Broadcast contribution for one request channel: local per-node request
     values (nonzero only at proposer rows) → [B, N_loc, P] value tensor for
     ``ring_push_max``.  ``ref_skip`` drops the sender's first peer (the
-    reference's iterator bug, paxos-node.cc:478-496)."""
+    reference's iterator bug, paxos-node.cc:478-496).  ``inmask`` ([N_loc,
+    P] bool) restricts delivery to receivers whose kregular in-table
+    contains the proposer (topo/spec.py) — paxos delivery is already
+    O(N*P), so the overlay is a static reachability mask on the SAME delay
+    draws: all-true at degree k = N-1, hence bit-equal to the full mesh."""
     n_loc = val_local.shape[0]
     val_g = dv._gather(val_local, axis)[:p]  # [P] global proposer values
     k = dv._shard_key(key, axis)
     d = delay_ops.sample_edge_delays(k, (n_loc, p), lo, hi, impl)
     prop_ids = jnp.arange(p)
     mask = (val_g[None, :] > 0) & (ids[:, None] != prop_ids[None, :])
+    if inmask is not None:
+        mask = mask & inmask
     if ref_skip:
         first_peer = jnp.where(prop_ids == 0, 1, 0)
         mask = mask & (ids[:, None] != first_peer[None, :])
@@ -288,7 +295,20 @@ def step(cfg, state: PaxosState, bufs: PaxosBufs, t, tkey):
     cmd_t = cmd_t * am
 
     # ---- gossip decode: TTL values → new-request dedup + forward set --------
-    gossip = cfg.topology == "kregular"
+    gossip = cfg.topology == "gossip"
+    # kregular overlay: requests reach only receivers whose in-table holds
+    # the proposer (static [N_loc, P] reachability mask over the SAME
+    # O(N*P) delivery — paxos has no N x N structure to sparsify); replies
+    # stay point-to-point on the reverse edge, the same response-overlay
+    # rule the gossip arm documents.  Clean-fidelity windows that cannot
+    # reach a majority simply time out and retry until gave_up.
+    kreg = cfg.topology == "kregular"
+    inmask = None
+    if kreg:
+        nbr_in_loc, _ = gd.local_tables(cfg, ids)
+        inmask = (
+            nbr_in_loc[:, :, None] == jnp.arange(p)[None, None, :]
+        ).any(axis=1)  # [N_loc, P]
     seen_req = state.seen_req
     fwd_vals = None
     if gossip:
@@ -543,7 +563,7 @@ def step(cfg, state: PaxosState, bufs: PaxosBufs, t, tkey):
                 (val > 0).any(),
                 lambda v=val, c=chan: _req_contrib(
                     chan_key(tkey, c), v, lo, hi, drop, axis, ids, p, ref_skip,
-                    impl=eimpl,
+                    impl=eimpl, inmask=inmask,
                 ),
                 zeros_req,
                 axis,

@@ -59,6 +59,7 @@ from flax import struct
 from blockchain_simulator_tpu.models.base import fault_masks, gated
 from blockchain_simulator_tpu.ops import delay as delay_ops
 from blockchain_simulator_tpu.ops import delivery as dv
+from blockchain_simulator_tpu.ops import gatherdeliv as gd
 from blockchain_simulator_tpu.ops import topology
 from blockchain_simulator_tpu.ops.ring import ring_pop, ring_push_add, ring_push_max
 from blockchain_simulator_tpu.utils.prng import Channel, chan_key
@@ -91,7 +92,7 @@ class PbftState:
     view_changes: jax.Array  # [N] view changes initiated
     alive: jax.Array         # [N] bool fault mask
     honest: jax.Array        # [N] bool fault mask
-    # gossip (topology="kregular") dedup state; zeros on the full mesh
+    # gossip (topology="gossip") dedup state; zeros on the full mesh
     seen_pp: jax.Array       # [N, W] highest TTL-encoded PRE_PREPARE seen
     seen_vc: jax.Array       # [N] highest TTL-encoded VIEW_CHANGE seen
     # queued-link transport registers (cfg.queued_links; [N,1] dummies off).
@@ -148,9 +149,9 @@ def init(cfg, key=None):
     n, s = cfg.n, cfg.pbft_max_slots
     w = eff_window(cfg)
     d = cfg.ring_depth
-    if cfg.topology == "kregular" and w < s:
+    if cfg.topology == "gossip" and w < s:
         raise ValueError(
-            "pbft gossip (topology='kregular') requires exact vote-table mode "
+            "pbft gossip (topology='gossip') requires exact vote-table mode "
             "(pbft_window = 0 or >= pbft_max_slots): a multi-hop PRE_PREPARE "
             "can trail its slot's direct-unicast COMMIT votes, which exact "
             "mode attributes by window identity while a window would misfile"
@@ -293,7 +294,7 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     else:
         ppq_tick = state.ppq_tick
 
-    # ---- gossip decode (topology="kregular"): the block-carrying channels
+    # ---- gossip decode (topology="gossip"): the block-carrying channels
     # (PRE_PREPARE) and the control channel (VIEW_CHANGE) flood over the k-out
     # digraph with a hop TTL; votes stay direct unicast — they are 4-byte
     # packets, and flooding them would need per-sender dedup state (O(N^2)),
@@ -301,7 +302,18 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     # (H = gossip_hops+1); a node processes each base value once (first
     # sighting) but forwards any strictly better TTL copy, so a nearly-expired
     # first arrival cannot truncate the flood (same scheme as models/paxos.py).
-    gossip = cfg.topology == "kregular"
+    gossip = cfg.topology == "gossip"
+    # kregular gather overlay (topo/spec.py): every channel delivers DIRECT
+    # to the circulant in/out neighbor tables through the O(N*K) gather
+    # primitives (ops/gatherdeliv.py) — no relay, no dedup state, and at
+    # degree k = N-1 bit-equal to the dense/full-mesh arms below (the sorted
+    # full-overlay table is the identity, so the same keys draw the same
+    # tensors).  With k below the commit quorum a node can never hear enough
+    # votes — a stalling-but-valid scenario (KNOWN_ISSUES topo note).
+    kreg = cfg.topology == "kregular"
+    nbr_in_loc = nbr_out_loc = None
+    if kreg:
+        nbr_in_loc, nbr_out_loc = gd.local_tables(cfg, ids)
     seen_pp, seen_vc = state.seen_pp, state.seen_vc
     pp_fwd = vc_fwd = None
     nbrs_loc = None
@@ -381,15 +393,22 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
         # slice — bit-equal to the unfused sample → expand → ring_push_add
         # compose, without the [B2, N, W] stacked intermediate.  The gated
         # fallback returns the ring UNTOUCHED, which is what pushing an
-        # all-zero contribution produced.
-        n_voters = voters.astype(jnp.int32).sum()
-        if axis is not None:
-            n_voters = jax.lax.psum(n_voters, axis)
+        # all-zero contribution produced.  The kregular overlay swaps ONLY
+        # the per-sender peer count — a gather over the out-table instead
+        # of total-minus-self — and rides the same fused chain on the same
+        # key (equal counts at k = N-1, hence bit-equal).
+        if kreg:
+            n_peers = gd.out_counts(voters, nbr_out_loc, ids, axis)
+        else:
+            n_voters = voters.astype(jnp.int32).sum()
+            if axis is not None:
+                n_voters = jax.lax.psum(n_voters, axis)
+            n_peers = n_voters - voters.astype(jnp.int32)
         prep_rt = gated(
             prep_active.any(),
             lambda: dv.push_roundtrip_reply_counts_stat(
                 prep_rt, t, rt_lo, k_rt, prep_active,
-                n_voters - voters.astype(jnp.int32), rt_probs, drop,
+                n_peers, rt_probs, drop,
                 axis=axis, mode=smode,
                 # replies are per broadcast, i.e. per active (node, window)
                 expand=lambda c: c[:, None] * got_pp_i,
@@ -400,9 +419,14 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     else:
         rt_counts = gated(
             prep_active.any(),
-            lambda: dv.roundtrip_reply_counts_dense(
-                k_rt, prep_active, lo, hi, drop, peer_mask=voters, axis=axis,
-                impl=eimpl,
+            lambda: (
+                gd.roundtrip_reply_counts_kreg(
+                    k_rt, prep_active, nbr_out_loc, ids, lo, hi, drop,
+                    peer_mask=voters, axis=axis, impl=eimpl,
+                ) if kreg else dv.roundtrip_reply_counts_dense(
+                    k_rt, prep_active, lo, hi, drop, peer_mask=voters,
+                    axis=axis, impl=eimpl,
+                )
             ),
             jnp.zeros((len(rt_probs), n_loc), jnp.int32),
             axis,
@@ -440,12 +464,19 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     k_cm = chan_key(tkey, Channel.DELAY_BCAST)
     zeros_w = jnp.zeros((hi - lo, n_loc, w), jnp.int32)
     if stat:
-        # fused chain-into-ring (see the prep_rt channel above)
+        # fused chain-into-ring (see the prep_rt channel above); the
+        # kregular twin gathers the per-(receiver, slot) sender counts
+        # over the in-table instead of totals-minus-own
         commit = gated(
             (commit_mat > 0).any(),
-            lambda: dv.push_bcast_slots_stat(
-                commit, t, lo, k_cm, commit_mat, ow_probs, drop, axis=axis,
-                mode=smode,
+            lambda: (
+                gd.push_bcast_slots_stat_kreg(
+                    commit, t, lo, k_cm, commit_mat, nbr_in_loc, ids,
+                    ow_probs, drop, axis=axis, mode=smode,
+                ) if kreg else dv.push_bcast_slots_stat(
+                    commit, t, lo, k_cm, commit_mat, ow_probs, drop,
+                    axis=axis, mode=smode,
+                )
             ),
             commit,
             axis,
@@ -453,8 +484,13 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     else:
         cm_contrib = gated(
             (commit_mat > 0).any(),
-            lambda: dv.bcast_slots_dense(k_cm, commit_mat, lo, hi, drop, axis=axis,
-                                         impl=eimpl),
+            lambda: (
+                gd.bcast_slots_kreg(k_cm, commit_mat, nbr_in_loc, ids, lo,
+                                    hi, drop, axis=axis, impl=eimpl)
+                if kreg else
+                dv.bcast_slots_dense(k_cm, commit_mat, lo, hi, drop,
+                                     axis=axis, impl=eimpl)
+            ),
             zeros_w,
             axis,
         )
@@ -561,6 +597,20 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
             zeros_w,
             axis,
         )
+    elif kreg:
+        pp_contrib = gated(
+            send_block.any(),
+            lambda: (
+                gd.bcast_window_value_max_stat_kreg(
+                    k_pp, pp_val, nbr_in_loc, ow_probs, drop, axis=axis)
+                if stat else
+                gd.bcast_window_value_max_kreg(
+                    k_pp, pp_val, nbr_in_loc, ids, lo, hi, drop, axis=axis,
+                    impl=eimpl)
+            ),
+            zeros_w,
+            axis,
+        )
     elif stat:
         pp_contrib = gated(
             send_block.any(),
@@ -609,6 +659,19 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
             (vc_out > 0).any(),
             lambda: dv.gossip_fwd(k_vc, vc_out[:, None], nbrs_loc, n, lo, hi,
                                   drop, axis=axis, impl=eimpl)[:, :, 0],
+            zeros_flat,
+            axis,
+        )
+    elif kreg:
+        vc_contrib = gated(
+            trigger.any(),
+            lambda: (
+                gd.bcast_value_max_stat_kreg(k_vc, enc, nbr_in_loc, ow_probs,
+                                             drop, axis=axis)
+                if stat else
+                gd.bcast_value_max_kreg(k_vc, trigger, enc, nbr_in_loc, ids,
+                                        lo, hi, drop, axis=axis, impl=eimpl)
+            ),
             zeros_flat,
             axis,
         )

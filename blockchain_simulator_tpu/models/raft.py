@@ -46,7 +46,7 @@ Fidelity modes:
 - ``clean``: heartbeats re-arm the election timer (real failure detection) and
   a block commits as soon as acks reach the majority, latched once per round.
 
-Gossip topology (``topology="kregular"``, clean + stat only): the three
+Gossip topology (``topology="gossip"``, clean + stat only): the three
 broadcast channels — VOTE_REQ, plain HEARTBEAT, proposal HEARTBEAT — flood
 over a random k-out digraph with a hop TTL (time-monotone value encodings,
 per-channel ``seen`` dedup registers, same overlay as models/paxos.py);
@@ -66,6 +66,7 @@ from flax import struct
 from blockchain_simulator_tpu.models.base import fault_masks, gated
 from blockchain_simulator_tpu.ops import delay as delay_ops
 from blockchain_simulator_tpu.ops import delivery as dv
+from blockchain_simulator_tpu.ops import gatherdeliv as gd
 from blockchain_simulator_tpu.ops import topology
 from blockchain_simulator_tpu.ops.ring import ring_pop, ring_push_add, ring_push_max
 from blockchain_simulator_tpu.utils.prng import Channel, chan_key
@@ -99,7 +100,7 @@ class RaftState:
     block_tick: jax.Array     # [N, B] commit tick per block at the leader (-1)
     alive: jax.Array          # [N] bool fault mask
     honest: jax.Array         # [N] bool fault mask
-    # gossip (topology="kregular") dedup registers: highest TTL-encoded copy
+    # gossip (topology="gossip") dedup registers: highest TTL-encoded copy
     # seen per flooded channel (vote requests / plain heartbeats / proposals);
     # zeros and unused on the full mesh
     seen_vreq: jax.Array      # [N]
@@ -193,6 +194,10 @@ def init(cfg, key=None):
     )
     if cfg.delivery == "stat":
         vreq = zi(d, n)
+    elif cfg.topology == "kregular":
+        # edge-mode overlay: sender identity is the IN-slot, not a global
+        # column — [D, N, K] instead of [D, N, N] (the O(N*k) memory win)
+        vreq = zi(d, n, cfg.degree + 1)
     else:
         vreq = zi(d, n, n)
     bufs = RaftBufs(
@@ -248,7 +253,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
     else:
         vreq_t = vreq_t * am[:, None]
 
-    # ---- gossip decode (topology="kregular"): the three broadcast channels
+    # ---- gossip decode (topology="gossip"): the three broadcast channels
     # (VOTE_REQ, plain HEARTBEAT, proposal HEARTBEAT) flood over the k-out
     # digraph with a hop TTL; replies (votes, proposal acks) stay direct
     # unicast to the decoded originator — the same overlay as models/paxos.py.
@@ -257,7 +262,19 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
     # (t+1)*(n+1) + leader + 1 (the +1 keeps 0 = empty).  A node processes
     # each base value once (first sighting) but forwards any strictly better
     # TTL copy, so a nearly-expired first arrival cannot truncate the flood.
-    gossip = cfg.topology == "kregular"
+    gossip = cfg.topology == "gossip"
+    # kregular gather overlay (topo/spec.py + ops/gatherdeliv.py): every
+    # channel delivers DIRECT over the circulant in/out tables — broadcasts
+    # reach out-neighbors, replies gather back requester-side through the
+    # inslot cross-index (scatter-free) — O(N*K) per tick, bit-equal to the
+    # dense arms at degree k = N-1.  A candidate only ever hears its
+    # in-neighbors' votes, so elections need k >= majority_need - 1 to be
+    # winnable (stalling below that is a valid modeled outcome).
+    kreg = cfg.topology == "kregular"
+    nbr_in_loc = nbr_out_loc = inslot_loc = None
+    if kreg:
+        nbr_in_loc, nbr_out_loc, inslot_loc = gd.local_tables(
+            cfg, ids, inslot=True)
     seen_vreq, seen_hb, seen_prop = state.seen_vreq, state.seen_hb, state.seen_prop
     vreq_fwd = hb_fwd = prop_fwd = None
     nbrs_loc = None
@@ -382,8 +399,17 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
         # Byzantine receivers flip their replies (grant<->deny on the wire)
         ok_wire = (grant & state.honest) | (deny & ~state.honest)
         no_wire = (deny & state.honest) | (grant & ~state.honest)
-        # per-candidate reply counts (global scatter-add), multinomially spread
+        # per-candidate reply counts, multinomially spread: a global
+        # scatter-add on the full mesh; the overlay routes them
+        # requester-side instead — candidate c gathers its out-neighbors'
+        # wires and keeps those addressed to it (ops/gatherdeliv.
+        # reply_counts_by_target_kreg: equal counts at k = N-1, and the
+        # kregular program stays scatter-free, KNOWN_ISSUES #0i)
         def reply_counts(wire):
+            if kreg:
+                return gd.reply_counts_by_target_kreg(
+                    wire, grant_to, nbr_out_loc, ids, axis
+                )
             c = jnp.zeros((n,), jnp.int32).at[grant_to].add(
                 wire.astype(jnp.int32), mode="drop"
             )
@@ -437,15 +463,23 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
         ok_wire = grant_mask * hn + deny_mask * (1 - hn)
         no_wire = deny_mask * hn + grant_mask * (1 - hn)
         k_vr = chan_key(tkey, Channel.DELAY_REPLY)
+        if kreg:
+            # slot-indexed wires route back requester-side through the
+            # inslot cross-index gather — no scatter, same keys/folds as
+            # the dense unicast (bit-equal at k = N-1)
+            def _unicast(kk, wire):
+                return gd.unicast_reply_counts_kreg(
+                    kk, wire, nbr_in_loc, nbr_out_loc, inslot_loc, ids,
+                    lo, hi, drop, axis=axis, impl=eimpl)
+        else:
+            def _unicast(kk, wire):
+                return dv.unicast_reply_counts_dense(
+                    kk, wire, lo, hi, drop, axis=axis, impl=eimpl)
         both = gated(
             any_req.any(),
             lambda: jnp.stack([
-                dv.unicast_reply_counts_dense(
-                    jax.random.fold_in(k_vr, 7), ok_wire, lo, hi, drop,
-                    axis=axis, impl=eimpl),
-                dv.unicast_reply_counts_dense(
-                    jax.random.fold_in(k_vr, 8), no_wire, lo, hi, drop,
-                    axis=axis, impl=eimpl),
+                _unicast(jax.random.fold_in(k_vr, 7), ok_wire),
+                _unicast(jax.random.fold_in(k_vr, 8), no_wire),
             ]),
             jnp.zeros((2, hi - lo, n_loc), jnp.int32),
             axis,
@@ -585,10 +619,26 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
     elif stat:
         vq_contrib = gated(
             fire.any(),
-            lambda: dv.bcast_value_max_stat(
-                k_vq, (ids + 1) * fire.astype(jnp.int32), ow_probs, drop,
-                axis=axis),
+            lambda: (
+                gd.bcast_value_max_stat_kreg(
+                    k_vq, (ids + 1) * fire.astype(jnp.int32), nbr_in_loc,
+                    ow_probs, drop, axis=axis)
+                if kreg else
+                dv.bcast_value_max_stat(
+                    k_vq, (ids + 1) * fire.astype(jnp.int32), ow_probs, drop,
+                    axis=axis)
+            ),
             zeros_flat,
+            axis,
+        )
+        vreq = ring_push_max(vreq, t, lo, vq_contrib)
+    elif kreg:
+        vq_contrib = gated(
+            fire.any(),
+            lambda: gd.bcast_matrix_kreg(
+                k_vq, fire, fire.astype(jnp.int32), nbr_in_loc, ids, lo, hi,
+                drop, axis=axis, impl=eimpl),
+            jnp.zeros((hi - lo, n_loc, cfg.degree + 1), jnp.int32),
             axis,
         )
         vreq = ring_push_max(vreq, t, lo, vq_contrib)
@@ -701,6 +751,45 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
             axis,
         )
         hb_prop = ring_push_max(hb_prop, t, lo + ser, prop_contrib)
+    elif kreg:
+        if stat:
+            plain_contrib = gated(
+                plain_send.any(),
+                # mode stays exact for the same O(1)-sender reason as the
+                # full-mesh stat arm below
+                lambda: gd.bcast_counts_stat_kreg(
+                    k_hb, plain_send, nbr_in_loc, ids, ow_probs, drop,
+                    axis=axis, mode="exact"),
+                zeros_flat,
+                axis,
+            )
+            prop_contrib = gated(
+                prop_send.any(),
+                lambda: gd.bcast_value_max_stat_kreg(
+                    jax.random.fold_in(k_hb, 1),
+                    (ids + 1) * prop_send.astype(jnp.int32), nbr_in_loc,
+                    ow_probs, drop, axis=axis),
+                zeros_flat,
+                axis,
+            )
+        else:
+            plain_contrib = gated(
+                plain_send.any(),
+                lambda: gd.bcast_counts_kreg(
+                    k_hb, plain_send, nbr_in_loc, ids, lo, hi, drop,
+                    axis=axis, impl=eimpl),
+                zeros_flat,
+                axis,
+            )
+            prop_contrib = gated(
+                prop_send.any(),
+                lambda: gd.bcast_value_max_kreg(
+                    jax.random.fold_in(k_hb, 1), prop_send,
+                    (ids + 1) * prop_send.astype(jnp.int32), nbr_in_loc,
+                    ids, lo, hi, drop, axis=axis, impl=eimpl),
+                zeros_flat,
+                axis,
+            )
     elif stat:
         plain_contrib = gated(
             plain_send.any(),
@@ -787,38 +876,49 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
         hb_bad = hb_bad.at[:, col_c].add(jnp.where(owned, hist_bad, 0))
     elif stat:
         # fused chain-into-ring (ops/delivery.push_roundtrip_reply_counts_
-        # stat) — bit-equal to the former sample → ring_push_add compose
-        n_voters = _psum_scalar(voters.astype(jnp.int32).sum(), axis)
-        n_liars = _psum_scalar(liars.astype(jnp.int32).sum(), axis)
+        # stat) — bit-equal to the former sample → ring_push_add compose.
+        # The kregular overlay swaps only the per-sender peer counts for
+        # out-table gathers (equal at k = N-1, same keys/chain).
+        if kreg:
+            ok_peers = gd.out_counts(voters, nbr_out_loc, ids, axis)
+            bad_peers = gd.out_counts(liars, nbr_out_loc, ids, axis)
+        else:
+            n_voters = _psum_scalar(voters.astype(jnp.int32).sum(), axis)
+            n_liars = _psum_scalar(liars.astype(jnp.int32).sum(), axis)
+            ok_peers = n_voters - voters.astype(jnp.int32)
+            bad_peers = n_liars - liars.astype(jnp.int32)
         hb_ok, hb_bad = gated(
             prop_send.any(),
             lambda: (
                 dv.push_roundtrip_reply_counts_stat(
                     hb_ok, t, rt_lo + ser, k_rt, prop_send,
-                    n_voters - voters.astype(jnp.int32), rt_probs, drop,
+                    ok_peers, rt_probs, drop,
                     axis=axis, mode=smode),
                 dv.push_roundtrip_reply_counts_stat(
                     hb_bad, t, rt_lo + ser, jax.random.fold_in(k_rt, 1),
-                    prop_send, n_liars - liars.astype(jnp.int32), rt_probs,
+                    prop_send, bad_peers, rt_probs,
                     drop, axis=axis, mode=smode),
             ),
             (hb_ok, hb_bad),
             axis,
         )
     else:
+        if kreg:
+            def _rt(kk, peers):
+                return gd.roundtrip_reply_counts_kreg(
+                    kk, prop_send, nbr_out_loc, ids, lo, hi, drop,
+                    peer_mask=peers, axis=axis, impl=eimpl)
+        else:
+            def _rt(kk, peers):
+                return dv.roundtrip_reply_counts_dense(
+                    kk, prop_send, lo, hi, drop, peer_mask=peers, axis=axis,
+                    impl=eimpl)
         ok_counts = gated(
-            prop_send.any(),
-            lambda: dv.roundtrip_reply_counts_dense(
-                k_rt, prop_send, lo, hi, drop, peer_mask=voters, axis=axis,
-                impl=eimpl),
-            zeros_rt,
-            axis,
+            prop_send.any(), lambda: _rt(k_rt, voters), zeros_rt, axis,
         )
         bad_counts = gated(
             prop_send.any(),
-            lambda: dv.roundtrip_reply_counts_dense(
-                jax.random.fold_in(k_rt, 1), prop_send, lo, hi, drop,
-                peer_mask=liars, axis=axis, impl=eimpl),
+            lambda: _rt(jax.random.fold_in(k_rt, 1), liars),
             zeros_rt,
             axis,
         )

@@ -420,7 +420,7 @@ def push_roundtrip_reply_counts_stat(buf, t, push_lo: int, key, send, n_peers,
 
 
 # --------------------------------------------------------------------------- #
-# gossip flood forwarding (kregular topology)                                 #
+# gossip flood forwarding (gossip topology)                                 #
 # --------------------------------------------------------------------------- #
 
 

@@ -1,0 +1,316 @@
+"""Gather-based sparse delivery: neighbor-index tables instead of N x N.
+
+The ``topology="kregular"`` twin of ops/delivery.py.  Every primitive here
+consumes the circulant overlay tables of topo/spec.py — local ``[n_loc,
+K]`` slices whose values are GLOBAL node ids, K = degree + 1 (the self
+slot rides along and is masked) — and costs O(N*K) per tick where the
+dense primitive costs O(N^2): delays are drawn slot-major ``[K, N]``, and
+sender-side values reach receivers through ``jnp.take`` gathers (the MoE
+routing / sparse-attention dispatch shape).  No primitive here scatters —
+even the reply channels, which route *requester-side* through the
+``inslot`` cross-index table, so the whole kregular tick body lowers
+scatter-free (KNOWN_ISSUES #0i; pinned in tests/test_zztopo.py).
+
+Bit-equality contract (the repo's correctness pin, tests/test_zztopo.py):
+at degree k = N-1 the sorted overlay tables are the identity permutation
+(topo/spec.py), every delay/drop tensor here has the SAME shape and is
+drawn from the SAME key as its dense twin, and every mask/reduction runs
+over the same index set — so the sparse program's integer channel values
+(hence its metrics) equal the dense program's bit for bit under
+``stat_sampler="exact"`` + ``edge_sampler="threefry"``.
+
+SPMD: same convention as ops/delivery.py — receiver rows stay local,
+sender-side quantities globalize with ``all_gather`` (``axis`` is the mesh
+axis name; None = unsharded).  The tables are static trace constants
+sliced to local rows by the caller (models pass ``nbr[ids]``), exactly
+like the gossip arm's ``nbrs_loc``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from blockchain_simulator_tpu.ops import delivery as dv
+from blockchain_simulator_tpu.ops.delay import (
+    binom,
+    sample_bucket_counts,
+    sample_edge_delays,
+)
+
+
+# ------------------------------------------------------------- tables -------
+
+
+def local_tables(cfg, ids, inslot: bool = False):
+    """The overlay tables of ``cfg``, sliced to this shard's rows: ``(in,
+    out)`` or ``(in, out, inslot)`` — the one localization call site the
+    three models share (the tables are trace constants; ``ids`` is the
+    shard's global row ids, so unsharded this is the whole table)."""
+    from blockchain_simulator_tpu.topo import spec as topo_spec
+
+    args = (cfg.n, cfg.degree, cfg.topo_seed)
+    tabs = [topo_spec.in_table(*args), topo_spec.out_table(*args)]
+    if inslot:
+        tabs.append(topo_spec.inslot_table(*args))
+    return tuple(jnp.take(jnp.asarray(t), ids, axis=0) for t in tabs)
+
+
+# ------------------------------------------------------------ gather sums ---
+
+
+def in_counts(x, nbr_in_loc, ids, axis=None):
+    """Per-receiver sum of a local int/bool ``[N_loc]`` vector over TRUE
+    in-neighbors (self slot excluded): the kregular replacement for the
+    dense stat chains' ``total - own`` sender counts.  Returns [N_loc]."""
+    x_g = dv._gather(x.astype(jnp.int32), axis)
+    vals = jnp.take(x_g, nbr_in_loc)                     # [N_loc, K]
+    notself = (nbr_in_loc != ids[:, None]).astype(jnp.int32)
+    return (vals * notself).sum(1)
+
+
+def out_counts(x, nbr_out_loc, ids, axis=None):
+    """Per-sender count of its out-neighbors inside a local mask ``x``
+    (self excluded) — the gathered ``n_peers`` of the round-trip stat
+    chains.  Returns [N_loc]."""
+    x_g = dv._gather(x.astype(jnp.int32), axis)
+    vals = jnp.take(x_g, nbr_out_loc)
+    notself = (nbr_out_loc != ids[:, None]).astype(jnp.int32)
+    return (vals * notself).sum(1)
+
+
+# ------------------------------------------------- edge-exact (slot-major) ---
+
+
+def _slot_hits(key, send_g, nbr_in_loc, ids, lo, hi, drop, axis, impl):
+    """[B, K, N_loc] 0/1 delivery indicators — the slot-major twin of
+    dv._edge_hits' [B, N_glob, N_loc]: delay/drop tensors are [K, N_loc]
+    on the SAME key, so at K = N (identity tables) the arrays are equal."""
+    n_loc, k1 = nbr_in_loc.shape
+    k = dv._shard_key(key, axis)
+    d = sample_edge_delays(k, (k1, n_loc), lo, hi, impl)
+    src = nbr_in_loc.T                                    # [K, N_loc]
+    notself = src != ids[None, :]
+    mask = jnp.take(send_g.astype(jnp.int32), src) * notself.astype(jnp.int32)
+    if drop > 0.0:
+        keep = jax.random.bernoulli(
+            jax.random.fold_in(k, 0x0D0D), 1.0 - drop, (k1, n_loc)
+        )
+        mask = mask * keep.astype(jnp.int32)
+    return (d[None] == dv._bucket_iota(lo, hi, d.ndim)).astype(jnp.int32) * mask[None]
+
+
+def bcast_counts_kreg(key, send, nbr_in_loc, ids, lo, hi, drop=0.0, axis=None,
+                      impl="threefry"):
+    """Overlay broadcast -> per-receiver arrival counts.  [B, N_loc]."""
+    send_g = dv._gather(send, axis)
+    return _slot_hits(key, send_g, nbr_in_loc, ids, lo, hi, drop, axis,
+                      impl).sum(1)
+
+
+def bcast_value_max_kreg(key, send, value, nbr_in_loc, ids, lo, hi, drop=0.0,
+                         axis=None, impl="threefry"):
+    """Overlay value broadcast (>0; 0 = empty), max-combined.  [B, N_loc]."""
+    send_g = dv._gather(send, axis)
+    value_g = dv._gather(value, axis)
+    hits = _slot_hits(key, send_g, nbr_in_loc, ids, lo, hi, drop, axis, impl)
+    val_t = jnp.take(value_g.astype(jnp.int32), nbr_in_loc.T)  # [K, N_loc]
+    return (hits * val_t[None]).max(1)
+
+
+def bcast_slots_kreg(key, slot_mat, nbr_in_loc, ids, lo, hi, drop=0.0,
+                     axis=None, impl="threefry"):
+    """Overlay slot-keyed broadcast (pbft COMMIT waves): arrival counts per
+    (receiver, slot) gathered over in-neighbors.  [B, N_loc, S]."""
+    slot_g = dv._gather(slot_mat.astype(jnp.int32), axis)       # [N, S]
+    send_g = slot_g.max(axis=1) > 0
+    hits = _slot_hits(key, send_g, nbr_in_loc, ids, lo, hi, drop, axis, impl)
+    slot_slot = jnp.take(slot_g, nbr_in_loc, axis=0)            # [N_loc, K, S]
+    return jnp.einsum("bkj,jks->bjs", hits, slot_slot)
+
+
+def bcast_window_value_max_kreg(key, value_mat, nbr_in_loc, ids, lo, hi,
+                                drop=0.0, axis=None, impl="threefry"):
+    """Overlay per-window value broadcast (pbft PRE_PREPARE), receiver
+    max-combines per window.  [B, N_loc, W]."""
+    value_g = dv._gather(value_mat.astype(jnp.int32), axis)     # [N, W]
+    send_g = value_g.max(axis=1) > 0
+    hits = _slot_hits(key, send_g, nbr_in_loc, ids, lo, hi, drop, axis, impl)
+    val_slot = jnp.take(value_g, nbr_in_loc, axis=0)            # [N_loc, K, W]
+    return (hits[:, :, :, None] * jnp.swapaxes(val_slot, 0, 1)[None]).max(1)
+
+
+def bcast_matrix_kreg(key, send, value, nbr_in_loc, ids, lo, hi, drop=0.0,
+                      axis=None, impl="threefry"):
+    """Identity-preserving overlay broadcast (raft VOTE_REQ): ``value``
+    lands at ``[b, receiver_local, in_slot]`` — the K-slot twin of the
+    dense [B, N_loc, N_glob] matrix channel.  Slot s of receiver j is
+    sender ``nbr_in_loc[j, s]`` (rows sorted, so argmax-over-slots keeps
+    the dense path's lowest-candidate-id tie-break).  [B, N_loc, K]."""
+    send_g = dv._gather(send, axis)
+    value_g = dv._gather(value, axis)
+    hits = _slot_hits(key, send_g, nbr_in_loc, ids, lo, hi, drop, axis, impl)
+    val_t = jnp.take(value_g.astype(jnp.int32), nbr_in_loc.T)   # [K, N_loc]
+    return jnp.swapaxes(hits * val_t[None], 1, 2)
+
+
+def roundtrip_reply_counts_kreg(key, send, nbr_out_loc, ids, lo, hi, drop=0.0,
+                                peer_mask=None, axis=None, impl="threefry"):
+    """Short-circuited overlay round trip: sender i reaches its
+    out-neighbors, every eligible peer replies instantly with an
+    independent return delay.  [B2, N_loc], offset 2*lo."""
+    n_loc, k1 = nbr_out_loc.shape
+    peers = jnp.ones((n_loc,), bool) if peer_mask is None else peer_mask
+    peers_g = dv._gather(peers, axis)
+    k = dv._shard_key(key, axis)
+    d1 = sample_edge_delays(jax.random.fold_in(k, 1), (n_loc, k1), lo, hi, impl)
+    d2 = sample_edge_delays(jax.random.fold_in(k, 2), (n_loc, k1), lo, hi, impl)
+    total = d1 + d2
+    notself = nbr_out_loc != ids[:, None]
+    mask = (
+        send.astype(jnp.int32)[:, None]
+        * notself.astype(jnp.int32)
+        * jnp.take(peers_g.astype(jnp.int32), nbr_out_loc)
+    )
+    if drop > 0.0:
+        keep = jax.random.bernoulli(
+            jax.random.fold_in(k, 0x0D0E), (1.0 - drop) ** 2, (n_loc, k1)
+        )
+        mask = mask * keep.astype(jnp.int32)
+    lo2 = 2 * lo
+    nb = 2 * (hi - lo) - 1
+    return (
+        (total[None] == dv._bucket_iota(lo2, lo2 + nb, total.ndim)).astype(jnp.int32)
+        * mask[None]
+    ).sum(2)
+
+
+def unicast_reply_counts_kreg(key, reply_slots, nbr_in_loc, nbr_out_loc,
+                              inslot_loc, ids, lo, hi, drop=0.0, axis=None,
+                              impl="threefry"):
+    """Route per-(replier, in-slot) reply counts back to each requester —
+    WITHOUT a scatter: requester c gathers slot s of replier ``nbr_out_loc
+    [c, s]`` through the precomputed ``inslot`` cross-index (topo/spec.py:
+    the slot c occupies in that replier's in-table).  Delay/drop tensors
+    are replier-major [N_loc, K] on the dense function's key/folds, so at
+    K = N they equal the dense [N_loc, N_glob] draws.  [B, N_loc]."""
+    n_loc, k1 = reply_slots.shape
+    k = dv._shard_key(key, axis)
+    d = sample_edge_delays(k, (n_loc, k1), lo, hi, impl)
+    mask = (nbr_in_loc != ids[:, None]).astype(jnp.int32)
+    if drop > 0.0:
+        keep = jax.random.bernoulli(
+            jax.random.fold_in(k, 0x0D0F), 1.0 - drop, (n_loc, k1)
+        )
+        mask = mask * keep.astype(jnp.int32)
+    r = reply_slots.astype(jnp.int32) * mask
+    r_g = dv._gather(r, axis)                 # [N, K] replier-major, global
+    d_g = dv._gather(d, axis)
+    flat = nbr_out_loc * k1 + inslot_loc      # [N_loc, K] requester-side
+    rv = jnp.take(r_g.reshape(-1), flat)
+    dd = jnp.take(d_g.reshape(-1), flat)
+    return (
+        (dd[None] == dv._bucket_iota(lo, hi, dd.ndim)).astype(jnp.int32)
+        * rv[None]
+    ).sum(2)
+
+
+def reply_counts_by_target_kreg(wire, target, nbr_out_loc, ids, axis=None):
+    """Per-target reply totals WITHOUT the dense path's global scatter-add:
+    target c gathers ``wire`` over its out-neighbors and keeps repliers
+    whose decoded ``target`` id is c (a replier's target is always one of
+    its in-neighbors, so the out-gather covers every reply).  The raft
+    stat vote/ack router.  Returns [N_loc] int32."""
+    wire_g = dv._gather(wire.astype(jnp.int32), axis)
+    tgt_g = dv._gather(target, axis)
+    w = jnp.take(wire_g, nbr_out_loc)                    # [N_loc, K]
+    tg = jnp.take(tgt_g, nbr_out_loc)
+    return (w * (tg == ids[:, None])).sum(1)
+
+
+# ------------------------------------------------ stat (gathered counts) ----
+
+
+def bcast_counts_stat_kreg(key, send, nbr_in_loc, ids, probs: np.ndarray,
+                           drop=0.0, axis=None, mode="exact"):
+    """Stat twin of dv.bcast_counts_stat over the overlay: receiver j hears
+    from its ACTIVE in-neighbors (gathered count), buckets multinomial.
+    At k = N-1 the gathered count equals ``n_senders - is_sender`` and the
+    chain is bit-equal to the dense stat path.  [B, N_loc]."""
+    k = dv._shard_key(key, axis)
+    m = in_counts(send, nbr_in_loc, ids, axis)
+    if drop > 0.0:
+        m = jnp.round(
+            binom(jax.random.fold_in(k, 0x0D10), m, 1.0 - drop, mode)
+        ).astype(jnp.int32)
+    return sample_bucket_counts(k, m, probs, mode)
+
+
+def push_bcast_slots_stat_kreg(buf, t, push_lo: int, key, slot_mat,
+                               nbr_in_loc, ids, probs: np.ndarray, drop=0.0,
+                               axis=None, mode="exact"):
+    """Fused stat slot broadcast over the overlay (the kregular twin of
+    dv.push_bcast_slots_stat): per-(receiver, slot) sender counts come
+    from an in-neighbor gather-sum, then ride the same fused
+    chain-into-ring push on the same key."""
+    k = dv._shard_key(key, axis)
+    sm_g = dv._gather(slot_mat.astype(jnp.int32), axis)
+    vals = jnp.take(sm_g, nbr_in_loc, axis=0)            # [N_loc, K, S]
+    notself = (nbr_in_loc != ids[:, None]).astype(jnp.int32)
+    m = (vals * notself[:, :, None]).sum(1)              # [N_loc, S]
+    if drop > 0.0:
+        m = jnp.round(
+            binom(jax.random.fold_in(k, 0x0D12), m, 1.0 - drop, mode)
+        ).astype(jnp.int32)
+    return dv.push_bucket_counts(buf, t, push_lo, k, m, probs, mode)
+
+
+def bcast_value_max_stat_kreg(key, value, nbr_in_loc, probs: np.ndarray,
+                              drop=0.0, axis=None):
+    """Stat twin of dv.bcast_value_max_stat over the overlay: each receiver
+    gets the max value announced in its IN-neighborhood (self included —
+    matching the dense global max, where re-delivery to the announcer is a
+    harmless max-combine no-op) with one per-receiver delay draw.
+    [B, N_loc]."""
+    k = dv._shard_key(key, axis)
+    n_loc = value.shape[0]
+    value_g = dv._gather(value.astype(jnp.int32), axis)
+    vmax = jnp.take(value_g, nbr_in_loc).max(1)          # [N_loc]
+    nb = len(probs)
+    d = jax.random.categorical(k, jnp.log(jnp.asarray(probs) + 1e-30),
+                               shape=(n_loc,))
+    sent = (vmax > 0).astype(jnp.int32)
+    if drop > 0.0:
+        keep = jax.random.bernoulli(
+            jax.random.fold_in(k, 0x0D13), 1.0 - drop, (n_loc,)
+        )
+        sent = sent * keep.astype(jnp.int32)
+    return (
+        (d[None] == dv._bucket_iota(0, nb, d.ndim)).astype(jnp.int32)
+        * (sent * vmax)[None]
+    )
+
+
+def bcast_window_value_max_stat_kreg(key, value_mat, nbr_in_loc,
+                                     probs: np.ndarray, drop=0.0, axis=None):
+    """Stat twin of dv.bcast_window_value_max_stat over the overlay:
+    per-(receiver, window) in-neighborhood max, one delay draw each; a
+    receiver whose own announcement IS the neighborhood max is the sender
+    and gets nothing.  [B, N_loc, W]."""
+    k = dv._shard_key(key, axis)
+    vm = value_mat.astype(jnp.int32)
+    n_loc, w = vm.shape
+    value_g = dv._gather(vm, axis)
+    vmax = jnp.take(value_g, nbr_in_loc, axis=0).max(1)  # [N_loc, W]
+    nb = len(probs)
+    d = jax.random.categorical(k, jnp.log(jnp.asarray(probs) + 1e-30),
+                               shape=(n_loc, w))
+    recv = (vmax > 0) & (vm < vmax)
+    if drop > 0.0:
+        keep = jax.random.bernoulli(
+            jax.random.fold_in(k, 0x0D14), 1.0 - drop, (n_loc, w)
+        )
+        recv = recv & keep
+    val = recv.astype(jnp.int32) * vmax
+    return (d[None] == dv._bucket_iota(0, nb, d.ndim)).astype(jnp.int32) * val[None]

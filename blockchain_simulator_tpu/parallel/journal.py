@@ -220,6 +220,59 @@ class SweepJournal:
                 out[key] = rows
         return out
 
+    def compact(self, keep_keys=None) -> tuple[int, int]:
+        """WAL-style compaction (the KNOWN_ISSUES #0k follow-on): rewrite
+        the journal to ONLY the checksum-valid chunk lines whose key is in
+        ``keep_keys`` (None/empty = drop every chunk), dropping event
+        lines and corrupt/duplicate chunks outright.  Atomic replace; the
+        open handle and the completed-chunk cache reset so later appends
+        and lookups see the compacted file.
+
+        The serving daemon calls this at its startup compaction point
+        (serve/server.py, next to ``WriteAheadLog.compact``) keyed on its
+        PENDING ADMISSIONS: with a replay backlog every still-answerable
+        chunk is kept — a compacted journal replays those batches with
+        zero dispatches, same as before (pinned in tests) — and with no
+        backlog the file empties, so a live-traffic daemon's journal stays
+        proportional to its crash backlog instead of its history.
+
+        Returns ``(kept, dropped)`` chunk-line counts."""
+        keep = set() if keep_keys is None else {str(k) for k in keep_keys}
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        # the WHOLE read-filter-replace runs under the append lock: a
+        # concurrent append_chunk between the snapshot and os.replace
+        # would otherwise be silently deleted despite its fsync (the
+        # reads below take no lock of their own, so no reentrancy)
+        with self._lock:
+            lines = self.chunk_lines()
+            kept_recs = []
+            seen: set[str] = set()
+            for rec in lines:
+                key = str(rec.get("key"))
+                if key not in keep or key in seen:
+                    continue
+                # verify THIS line's own checksums — a corrupt line that
+                # precedes a valid duplicate must not be the one kept
+                rows, sums = rec.get("rows"), rec.get("sums")
+                if not isinstance(rows, list) or not isinstance(sums, list) \
+                        or len(rows) != len(sums) \
+                        or any(row_checksum(r) != s
+                               for r, s in zip(rows, sums)):
+                    continue
+                kept_recs.append(rec)
+                seen.add(key)
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+            with open(tmp, "w") as f:
+                for rec in kept_recs:
+                    f.write(_canonical_json(rec) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            self._completed = None
+        return len(kept_recs), len(lines) - len(kept_recs)
+
     def events(self) -> list[dict]:
         """Every supervisor event line, in order."""
         return [r for r in self.records() if r["op"] == "event"]

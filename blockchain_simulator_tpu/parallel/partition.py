@@ -285,7 +285,14 @@ def batched_out_shardings(cfg, mesh, out_avals):
     the constraint into the scan).  Only dim 1 is considered: node state
     is ``[N, ...]`` by repo convention (shard.py), and shape-matching
     deeper dims could tag a same-sized non-node dim (e.g. a slot table at
-    ``pbft_max_slots == n``) — such leaves just stay replicated."""
+    ``pbft_max_slots == n``) — such leaves just stay replicated.
+
+    Topology rule (topo/): the committee path's finals are stacked
+    ``[B, C, m, ...]`` (topo/committee.py) — there dim 1 is the COMMITTEE
+    axis, the node-dim analog of the hierarchy, and it rides the nodes
+    axis when it divides evenly; kregular finals keep the flat ``[B, N,
+    ...]`` shape and need no new rule (its index tables are per-shard
+    trace constants sliced by local ids, like the gossip arm's)."""
     import jax
 
     from blockchain_simulator_tpu.parallel.mesh import NODES_AXIS, SWEEP_AXIS
@@ -293,13 +300,14 @@ def batched_out_shardings(cfg, mesh, out_avals):
     P = _spec_cls()
     n_nodes = int(dict(mesh.shape).get(NODES_AXIS, 1))
     sweep = SWEEP_AXIS if sweep_axis_size(mesh) > 1 else None
+    node_dim = (cfg.committees if cfg.topology == "committee" else cfg.n)
 
     def leaf_spec(leaf):
         shape = tuple(getattr(leaf, "shape", ()))
         entries = [sweep]
         for i, d in enumerate(shape[1:]):
-            if (i == 0 and n_nodes > 1 and d == cfg.n
-                    and cfg.n % n_nodes == 0):
+            if (i == 0 and n_nodes > 1 and d == node_dim
+                    and node_dim % n_nodes == 0):
                 entries.append(NODES_AXIS)
             else:
                 entries.append(None)

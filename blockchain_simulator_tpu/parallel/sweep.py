@@ -49,7 +49,7 @@ import jax
 import jax.numpy as jnp
 
 from blockchain_simulator_tpu.chaos import inject
-from blockchain_simulator_tpu.models.base import canonical_fault_cfg, get_protocol
+from blockchain_simulator_tpu.models.base import canonical_fault_cfg, sim_metrics
 from blockchain_simulator_tpu.parallel import journal as journal_mod
 from blockchain_simulator_tpu.parallel import partition
 from blockchain_simulator_tpu.parallel.mesh import NODES_AXIS, SWEEP_AXIS
@@ -193,7 +193,6 @@ def multi_seed_fn(cfg: SimConfig, n_seeds: int):
 def run_seed_sweep(cfg: SimConfig, seeds, mesh=None):
     """Run ``len(seeds)`` simulations of one config in a single vmapped
     program; returns a list of per-seed metrics dicts."""
-    proto = get_protocol(cfg.protocol)
     # Every schedule is fully traceable — including round-schedule raft,
     # whose checked handoff is a lax.cond (models/raft_hb.scan_from_init)
     # that vmap lowers to a select: both branches run for the whole batch,
@@ -211,7 +210,7 @@ def run_seed_sweep(cfg: SimConfig, seeds, mesh=None):
     out = []
     for i, seed in enumerate(seeds):
         final_i = jax.tree.map(lambda x: x[i], finals)
-        m = proto.metrics(cfg, final_i)
+        m = sim_metrics(cfg, final_i)
         # observability routing: a finalized COPY of every sweep row goes to
         # the optional runs.jsonl ($BLOCKSIM_RUNS_JSONL, utils/obs.py); the
         # returned dicts stay pure metrics — tests compare them bit-for-bit
@@ -265,9 +264,8 @@ def _dispatch_dyn_points(canon: SimConfig, points, record: bool = True,
     if n_out is not None:
         points = points[:n_out]
     for i, (cfg_i, seed) in enumerate(points):
-        proto = get_protocol(cfg_i.protocol)
         final_i = jax.tree.map(lambda x: x[i], finals)
-        m = proto.metrics(cfg_i, final_i)
+        m = sim_metrics(cfg_i, final_i)
         if record:
             obs.record_run({"seed": int(seed), **m}, cfg_i)
         out.append(m)

@@ -51,6 +51,18 @@ def use_round_schedule(cfg: SimConfig) -> bool:
     heartbeat scan inside every raft shard)?"""
     if cfg.schedule == "tick":
         return False
+    if cfg.topology in ("kregular", "committee"):
+        # the phase-blocked fast paths are full-mesh aggregates
+        # (pbft_round/raft_hb eligibility already pins topology == "full");
+        # the sparse/hierarchical axes run the general tick engine — inside
+        # each committee too (topo/committee.py runs proto.step per tick)
+        if cfg.schedule == "round":
+            raise ValueError(
+                f"schedule='round' is a full-mesh fast path; topology="
+                f"{cfg.topology!r} runs the tick engine (use schedule="
+                "'tick' or 'auto')"
+            )
+        return False
     if cfg.protocol == "raft":
         from blockchain_simulator_tpu.models import raft_hb
 
@@ -188,6 +200,25 @@ def make_sim_fn(cfg: SimConfig):
     specs; ``python -m blockchain_simulator_tpu.lint.graph``).
     """
     _reject_cpp_only(cfg)
+    if cfg.topology == "committee":
+        from blockchain_simulator_tpu.topo import committee
+
+        use_round_schedule(cfg)  # validates schedule='round' (always tick)
+        # static arm of the committee hierarchy: the config's own fault
+        # counts ride the (traced) operand slots of the shared dyn body,
+        # mirroring the static==dyn equality every protocol pins
+        # (tests/test_zsweep_cache.py), so ONE body serves both doors
+        canon = base_model.canonical_fault_cfg(cfg)
+        nc = cfg.faults.resolved_n_crashed(cfg.n)
+        nb = cfg.faults.n_byzantine
+
+        @jax.jit
+        def sim_committee(key):
+            return committee.run_stacked(
+                canon, key, jnp.int32(nc), jnp.int32(nb)
+            )
+
+        return sim_committee
     if use_round_schedule(cfg):
         if cfg.protocol == "raft":
             from blockchain_simulator_tpu.models import raft_hb
@@ -252,6 +283,12 @@ def make_dyn_sim_fn(cfg: SimConfig):
     _reject_cpp_only(cfg)
     n = cfg.n
 
+    if cfg.topology == "committee":
+        from blockchain_simulator_tpu.topo import committee
+
+        use_round_schedule(cfg)  # validates schedule='round' (always tick)
+        return functools.partial(committee.run_stacked, cfg)
+
     if use_round_schedule(cfg):
         if cfg.protocol == "raft":
             from blockchain_simulator_tpu.models import raft as raft_tick
@@ -303,14 +340,13 @@ def run_simulation(cfg: SimConfig, seed: int | None = None, with_timing: bool = 
     compile-vs-execution split every timing surface shares — and reports
     both ``compile_plus_first_run_s`` and the execution-only
     ``wallclock_s``."""
-    proto = get_protocol(cfg.protocol)
     sim = make_sim_fn(cfg)
     key = jax.random.key(cfg.seed if seed is None else seed)
     if with_timing:
         from blockchain_simulator_tpu.utils import obs
 
         final, compile_s, wall = obs.timed_run(sim, key)
-        m = proto.metrics(cfg, final)
+        m = base_model.sim_metrics(cfg, final)
         m["wallclock_s"] = wall
         m["compile_plus_first_run_s"] = round(compile_s, 3)
         m["ticks"] = cfg.ticks
@@ -318,7 +354,7 @@ def run_simulation(cfg: SimConfig, seed: int | None = None, with_timing: bool = 
     # force_sync, not block_until_ready: the latter returns before execution
     # completes on this env's axon backend (KNOWN_ISSUES.md #1)
     final = force_sync(sim(key))
-    return proto.metrics(cfg, final)
+    return base_model.sim_metrics(cfg, final)
 
 
 def final_state(cfg: SimConfig, seed: int | None = None):
@@ -361,6 +397,12 @@ def make_segment_fn(cfg: SimConfig, n_ticks: int):
     bit-identical to one uninterrupted scan — the checkpoint/resume substrate
     (the reference has none, SURVEY.md §5)."""
     _reject_cpp_only(cfg)
+    if cfg.topology == "committee":
+        raise ValueError(
+            "segmented/checkpointed execution steps the flat (state, bufs) "
+            "pair; the committee path's stacked state has no segment form "
+            "(topo/committee.py) — run it un-checkpointed"
+        )
     proto = get_protocol(cfg.protocol)
 
     @jax.jit

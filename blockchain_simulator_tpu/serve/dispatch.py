@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from blockchain_simulator_tpu.chaos import inject
-from blockchain_simulator_tpu.models.base import get_protocol
+from blockchain_simulator_tpu.models.base import sim_metrics
 from blockchain_simulator_tpu.runner import make_dyn_sim_fn
 from blockchain_simulator_tpu.serve import schema
 from blockchain_simulator_tpu.utils import aotcache, obs, telemetry
@@ -96,7 +96,7 @@ def _solo_metrics(req):
             final = jax.block_until_ready(
                 _solo_fn(req.canon)(keys[0], nc[0], nb[0])
             )
-        return get_protocol(req.cfg.protocol).metrics(req.cfg, final)
+        return sim_metrics(req.cfg, final)
     finally:
         req.t_dispatch1 = time.monotonic()
 

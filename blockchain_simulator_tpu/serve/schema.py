@@ -15,7 +15,12 @@ server accepts exactly what the engines accept.  Parsing also computes the
 request's **batch group**: the canonical fault structure
 (models/base.canonical_fault_cfg) whose dynamic-fault-operand executable
 serves it — requests sharing a group micro-batch into one vmapped dispatch
-(serve/dispatch.py).
+(serve/dispatch.py).  The grouping is topology-aware by construction: the
+topo/ axis fields (``topology``/``degree``/``committees``/``topo_seed``)
+ride the canonical config, so requests over one kregular overlay or one
+committee hierarchy batch together (seed and fault counts stay operands)
+while distinct topologies never share a dispatch group
+(tests/test_zztopo.py pins it).
 
 Rejections are typed, never stringly: every failure mode is a
 :class:`ServeError` subclass with an HTTP-style ``code`` and a stable

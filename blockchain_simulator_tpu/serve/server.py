@@ -263,6 +263,19 @@ class ScenarioServer:
             self._wal_claimed_by = fleet.claim_owner(wal_path)
             if self._wal_claimed_by is None:
                 self._wal.compact()
+                # the sweep journal compacts at the SAME point, keyed on
+                # the pending admissions (KNOWN_ISSUES #0k follow-on): a
+                # replay backlog keeps every valid chunk line (the replayed
+                # batches still answer from the journal, zero dispatches —
+                # parallel/journal.SweepJournal.compact), an empty backlog
+                # empties the file, so a live-traffic daemon's journal
+                # tracks its crash backlog, not its flush history
+                if self._journal is not None:
+                    keep = (
+                        set(self._journal.completed())
+                        if self._wal.pending() else ()
+                    )
+                    self._journal.compact(keep)
                 self._replay_wal()
             # else: a router holds this WAL's lease (serve/fleet.py) — the
             # pending ids are being replayed on a peer RIGHT NOW, so a

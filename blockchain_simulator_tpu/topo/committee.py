@@ -1,0 +1,148 @@
+"""Two-level committee consensus (``topology="committee"``).
+
+The hierarchy the scalable-BFT line runs in practice (PAPERS.md
+2007.12637): N nodes split into ``cfg.committees`` equal committees of
+m = N/C nodes; the FLAT protocol runs to quorum INSIDE each committee
+(node c*m is that committee's node 0 — pbft initial leader / paxos
+proposer lane 0), and an outer aggregate step over the committee
+representatives declares the hierarchy's outcome once an outer quorum
+(majority of committees) reports its inner milestone.
+
+Execution shape: one ``lax.map`` over the stacked committee axis of the
+UNVMAPPED inner tick engine — the same scatter-free batch body as the
+multi-seed arm (parallel/partition.seq_map rationale, KNOWN_ISSUES #0i):
+per-tick memory is O(C * f(m)) where f is the inner engine's footprint
+(edge mode: O(N*m) total instead of O(N^2) — the committee-size memory
+lever), and ring pushes stay plain dynamic-update-slices.
+
+Fault layout: masks keep the repo's global last-ids rule
+(models/base.dyn_fault_masks over the FULL id space, reshaped [C, m]) —
+fault counts therefore concentrate in the tail committees, whose inner
+consensus stalls first; counts stay traced operands, so ONE executable
+serves every fault level per (protocol, committee structure).
+
+One-committee contract (the pin in tests/test_zztopo.py): at C = 1 the
+committee keys ARE the flat sim's key stream and the body IS the flat
+dyn program, so the merged metrics dict contains the flat protocol's
+metrics bit for bit, and the outer step adds zero latency (a single
+representative has nobody to exchange with).
+
+The outer aggregate is deterministic modeling, not a second simulated
+consensus: representatives report their committee's inner milestone, and
+the outer commit lands at the outer-quorum-th milestone plus one
+worst-case representative round trip (``2*(one_way_hi - 1)``; 0 at
+C = 1).  A simulated outer instance over the C representatives is the
+natural extension (ROADMAP item 3 note).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from blockchain_simulator_tpu.models import base as base_model
+from blockchain_simulator_tpu.utils import prng
+
+
+def inner_cfg(cfg):
+    """The flat per-committee config: n = committee size, full mesh inside
+    the committee; everything else (protocol knobs, delivery, samplers,
+    fault structure) inherits."""
+    return cfg.with_(n=cfg.n // cfg.committees, topology="full")
+
+
+def _committee_keys(key, c: int):
+    """[C] stacked per-committee base keys.  C = 1 keeps the caller's key
+    verbatim (the flat-protocol contract); C > 1 folds the committee index
+    so committee streams decorrelate."""
+    if c == 1:
+        return key[None]
+    return jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(c))
+
+
+def run_stacked(cfg, key, n_crashed, n_byzantine):
+    """Traced committee sim: ``(key, n_crashed, n_byzantine) -> stacked
+    final state [C, ...]`` — the dynamic-fault-operand program
+    (runner.make_dyn_sim_fn committee arm; the static arm passes the
+    config's own counts).  ``cfg`` must already be fault-canonical, like
+    every dyn program (models/base.canonical_fault_cfg)."""
+    proto = base_model.get_protocol(cfg.protocol)
+    c, m = cfg.committees, cfg.n // cfg.committees
+    icfg = inner_cfg(cfg)
+    alive, honest = base_model.dyn_fault_masks(cfg.n, n_crashed, n_byzantine)
+    keys = _committee_keys(key, c)
+
+    def body(args):
+        kc, alive_c, honest_c = args
+        state, bufs = proto.init(icfg, jax.random.fold_in(kc, 0x1217))
+        state = base_model.apply_fault_masks(icfg, state, alive_c, honest_c)
+
+        def tick(carry, t):
+            st, bf = carry
+            st, bf = proto.step(icfg, st, bf, t, prng.tick_key(kc, t))
+            return (st, bf), ()
+
+        (state, bufs), _ = jax.lax.scan(
+            tick, (state, bufs), jnp.arange(icfg.ticks)
+        )
+        return state
+
+    return jax.lax.map(body, (keys, alive.reshape(c, m), honest.reshape(c, m)))
+
+
+def milestone_ms(protocol: str, inner_metrics: dict) -> float:
+    """One committee's inner-consensus milestone: the tick its inner quorum
+    completed the protocol's measured outcome, -1.0 if it never did."""
+    m = inner_metrics
+    if protocol == "pbft":
+        return float(m["last_commit_ms"]) if m["blocks_final_all_nodes"] > 0 \
+            else -1.0
+    if protocol == "raft":
+        return float(m["last_block_ms"]) if m["blocks"] > 0 else -1.0
+    return float(m["winner_commit_ms"]) if m["n_committed_proposers"] > 0 \
+        else -1.0
+
+
+def metrics(cfg, finals) -> dict:
+    """Host-side metrics of a stacked committee final state.
+
+    C = 1: the flat protocol's full metrics dict (bit-equal to the flat
+    run — the tests' contract) plus the ``outer_*`` keys.  C > 1: the
+    outer aggregate plus the per-committee milestone list (hand-checkable
+    against the formula: ``outer_commit_ms`` = outer-quorum-th smallest
+    decided milestone + one representative round trip)."""
+    proto = base_model.get_protocol(cfg.protocol)
+    c = cfg.committees
+    icfg = inner_cfg(cfg)
+    inner = [
+        proto.metrics(icfg, jax.tree.map(lambda x, i=i: x[i], finals))
+        for i in range(c)
+    ]
+    miles = [milestone_ms(cfg.protocol, m) for m in inner]
+    decided = sorted(t for t in miles if t >= 0)
+    quorum = c // 2 + 1
+    outer_round = 0.0 if c == 1 else float(2 * (cfg.one_way_range()[1] - 1))
+    outer_commit = (
+        decided[quorum - 1] + outer_round if len(decided) >= quorum else -1.0
+    )
+    outer = {
+        "topology": "committee",
+        "committees": c,
+        "committee_size": icfg.n,
+        "outer_quorum": quorum,
+        "committees_decided": len(decided),
+        "inner_milestones_ms": miles,
+        "outer_round_ms": outer_round,
+        "outer_commit_ms": float(outer_commit),
+        "inner_agreement_ok": all(
+            bool(m.get("agreement_ok", True)) for m in inner
+        ),
+    }
+    if c == 1:
+        return {**inner[0], **outer}
+    return {
+        "protocol": cfg.protocol,
+        "n": cfg.n,
+        "agreement_ok": outer["inner_agreement_ok"],
+        **outer,
+    }

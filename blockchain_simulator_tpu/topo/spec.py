@@ -1,0 +1,155 @@
+"""Topology representation: kinds, and the seeded k-regular overlay tables.
+
+``TopoSpec`` is the typed view of ``SimConfig``'s topology axis — the
+*structure* half of the runtime operand split: kind/degree/committees key
+the executable registry (they change program shapes), while fault counts
+and seeds stay traced operands riding ONE compiled program per topology.
+
+The ``kregular`` member is a **circulant** k-regular digraph: a seeded
+choice of k distinct offsets from 1..N-1 (offset 1 always included, so the
+successor ring guarantees strong connectivity) plus offset 0 (the self
+slot, masked at delivery).  Node j's in-neighbors are ``{(j + o) % N}``
+and its out-neighbors ``{(j - o) % N}`` over the same offset set, so the
+graph is k-in- AND k-out-regular with aligned slot tables — exactly what
+the requester-side reply *gathers* in ops/gatherdeliv.py need to stay
+scatter-free.
+
+Rows are sorted ascending.  That is the bit-equality mechanism the repo
+pins everything on: at degree k = N-1 the offset set is all of 0..N-1 and
+every sorted row is ``[0, 1, .., N-1]`` — the identity table — so the
+slot-major ``[K, N]`` delay draws of the gather path are the SAME arrays
+the dense ``[N, N]`` path draws from the same threefry keys, and the
+sparse program's metrics are bit-equal to the dense program's
+(tests/test_zztopo.py, per protocol).
+
+Pure numpy — importable with no jax/backend touch (jaxlint
+``module-scope-backend-touch``); builders are memoized, so the per-tick
+model code pays one table build per (n, degree, seed) per process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import numpy as np
+
+# Topology kinds (SimConfig.topology after the "dense" -> "full" alias
+# normalization).
+DENSE = "full"
+GOSSIP = "gossip"
+KREGULAR = "kregular"
+COMMITTEE = "committee"
+
+
+@dataclasses.dataclass(frozen=True)
+class TopoSpec:
+    """The structural identity of one topology: everything that changes
+    compiled program SHAPES (and therefore belongs in the registry key —
+    which it reaches automatically, being derived from SimConfig fields)."""
+
+    kind: str
+    n: int
+    degree: int = 0       # kregular overlay degree k (0 for other kinds)
+    committees: int = 0   # committee count (0 for other kinds)
+    seed: int = 0         # overlay-builder seed (kregular only)
+
+    @classmethod
+    def from_config(cls, cfg) -> "TopoSpec":
+        if cfg.topology == KREGULAR:
+            return cls(KREGULAR, cfg.n, degree=cfg.degree, seed=cfg.topo_seed)
+        if cfg.topology == COMMITTEE:
+            return cls(COMMITTEE, cfg.n, committees=cfg.committees)
+        return cls(cfg.topology, cfg.n)
+
+    @property
+    def slots(self) -> int:
+        """Neighbor-table slot count K = degree + 1 (the self slot rides
+        along, masked at delivery — at k = N-1, K = N and the table is the
+        identity permutation)."""
+        return self.degree + 1
+
+    @property
+    def committee_size(self) -> int:
+        return self.n // self.committees if self.committees else self.n
+
+
+@functools.lru_cache(maxsize=64)
+def circulant_offsets(n: int, degree: int, seed: int) -> tuple:
+    """The seeded offset set O of the circulant overlay: ``degree``
+    distinct values from 1..n-1 (offset 1 always included — the successor
+    ring makes the digraph strongly connected), plus offset 0 (self slot).
+    Deterministic in (n, degree, seed)."""
+    if not 1 <= degree <= n - 1:
+        raise ValueError(f"degree={degree} must be in [1, {n - 1}]")
+    if degree == n - 1:
+        return tuple(range(n))  # the full mesh: every offset
+    rng = np.random.default_rng(np.uint64(seed) ^ np.uint64(0x70B0_C14C))
+    rest = rng.choice(np.arange(2, n), size=degree - 1, replace=False)
+    return tuple(sorted({0, 1, *rest.tolist()}))
+
+
+@functools.lru_cache(maxsize=32)
+def _tables(n: int, degree: int, seed: int):
+    """(nbr_in, nbr_out, inslot_of_out) int32 tables, rows sorted.
+
+    - ``nbr_in[j]``  = sorted ``{(j + o) % n : o in O}``  — who j hears.
+    - ``nbr_out[i]`` = sorted ``{(i - o) % n : o in O}``  — who hears i.
+    - ``inslot_of_out[i, s]`` = the slot index of i inside
+      ``nbr_in[nbr_out[i, s]]`` — the cross-index that lets a requester
+      GATHER its per-slot replies back (ops/gatherdeliv.
+      unicast_reply_counts_kreg) instead of the repliers scattering them.
+
+    At degree n-1 all three are the identity-pattern tables (``nbr_in[j,s]
+    = s``), which is the whole bit-equality contract."""
+    offs = np.asarray(circulant_offsets(n, degree, seed), np.int64)
+    ids = np.arange(n, dtype=np.int64)[:, None]
+    nbr_in = np.sort((ids + offs[None, :]) % n, axis=1)
+    nbr_out = np.sort((ids - offs[None, :]) % n, axis=1)
+    # invert: i sits at exactly one slot of nbr_in[recv] for every receiver
+    # recv = nbr_out[i, s] (i in in(recv) <=> recv in out(i)); rows are
+    # sorted + distinct, so searchsorted is an exact index
+    rows = nbr_in[nbr_out]                       # [n, K, K]
+    inslot = np.argmax(rows == np.arange(n)[:, None, None], axis=2)
+    assert (np.take_along_axis(rows, inslot[:, :, None], 2)[:, :, 0]
+            == np.arange(n)[:, None]).all()
+    return (nbr_in.astype(np.int32), nbr_out.astype(np.int32),
+            inslot.astype(np.int32))
+
+
+def in_table(n: int, degree: int, seed: int) -> np.ndarray:
+    """[N, K] sorted in-neighbor table (K = degree + 1, self included)."""
+    return _tables(n, degree, seed)[0]
+
+
+def out_table(n: int, degree: int, seed: int) -> np.ndarray:
+    """[N, K] sorted out-neighbor table."""
+    return _tables(n, degree, seed)[1]
+
+
+def inslot_table(n: int, degree: int, seed: int) -> np.ndarray:
+    """[N, K]: ``inslot_table(..)[i, s]`` = slot of i in
+    ``in_table(..)[out_table(..)[i, s]]`` (the reply-gather cross-index)."""
+    return _tables(n, degree, seed)[2]
+
+
+def overlay_diameter(n: int, degree: int, seed: int) -> int:
+    """BFS diameter of the out-digraph from node 0 (validation aid; the
+    circulant is vertex-transitive, so one source suffices)."""
+    nbr = out_table(n, degree, seed)
+    dist = np.full(n, -1)
+    dist[0] = 0
+    frontier = [0]
+    hops = 0
+    while frontier:
+        hops += 1
+        nxt = []
+        for u in frontier:
+            for v in nbr[u]:
+                if dist[v] < 0:
+                    dist[v] = hops
+                    nxt.append(v)
+        frontier = nxt
+    if (dist < 0).any():
+        raise ValueError("overlay not strongly connected (builder bug)")
+    return int(dist.max())

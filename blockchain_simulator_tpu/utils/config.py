@@ -101,11 +101,32 @@ class SimConfig:
     queued_links: bool = False
 
     # --- topology -----------------------------------------------------------
-    topology: str = "full"  # "full" (reference, blockchain-simulator.cc:34-51)
-    # or "kregular" (random k-out gossip digraph for 10k+ nodes, BASELINE
-    # config 3: requests flood with a hop TTL instead of O(N) broadcasts)
-    degree: int = 16  # gossip out-degree when topology == "kregular"
+    # The runtime topology axis (topo/): how the N nodes are wired.
+    # "full"      — the reference's full mesh (blockchain-simulator.cc:34-51);
+    #               "dense" is an accepted alias, normalized to "full" so the
+    #               registry key / config hash is one spelling.
+    # "gossip"    — random k-out digraph over which block/control messages
+    #               FLOOD with a hop TTL (BASELINE config 3; the pre-topo/
+    #               spelling "kregular" meant this relay mode).
+    # "kregular"  — seeded circulant k-regular overlay with DIRECT
+    #               neighbor-index delivery: per-tick messages are gathered
+    #               through [N, k+1] in/out tables (topo/spec.py,
+    #               ops/gatherdeliv.py) instead of dense N x N edge tensors —
+    #               O(N*k) memory, and at degree k = N-1 bit-equal to the
+    #               full mesh (the sorted full-overlay table is the identity
+    #               permutation, so the same threefry draws are consumed).
+    # "committee" — two-level hierarchy: the protocol runs INSIDE each of
+    #               ``committees`` equal committees (lax.map over the stacked
+    #               committee axis, O(N * n/committees) memory), then an
+    #               outer aggregate step over committee representatives
+    #               (topo/committee.py).
+    topology: str = "full"
+    degree: int = 16  # out-degree: gossip flood fan-out / kregular overlay k
     gossip_hops: int = 8  # flood TTL; must cover the graph diameter (~log_deg N)
+    committees: int = 4  # committee count when topology == "committee"
+    topo_seed: int = 0  # kregular overlay-builder seed — deliberately separate
+    # from the run seed, so fault/seed sweeps over one overlay share ONE
+    # compiled program (the overlay is topology *structure*, not randomness)
 
     # --- execution backend --------------------------------------------------
     # "edge": exact per-edge delay sampling (O(N^2) work per active tick).
@@ -240,6 +261,8 @@ class SimConfig:
 
     # ------------------------------------------------------------------------
     def __post_init__(self):
+        if self.topology == "dense":  # alias: one spelling in the registry key
+            object.__setattr__(self, "topology", "full")
         if self.protocol not in ("pbft", "raft", "paxos", "mixed"):
             raise ValueError(f"unknown protocol {self.protocol!r}")
         if self.delivery not in ("edge", "stat"):
@@ -272,8 +295,11 @@ class SimConfig:
                     "pbft_max_rounds must be < pbft_max_slots so no honest "
                     "leader ever proposes it"
                 )
-        if self.topology not in ("full", "kregular"):
-            raise ValueError(f"unknown topology {self.topology!r}")
+        if self.topology not in ("full", "gossip", "kregular", "committee"):
+            raise ValueError(
+                f"unknown topology {self.topology!r} (valid: full/dense, "
+                "gossip, kregular, committee)"
+            )
         if self.protocol == "paxos" and not 1 <= self.paxos_n_proposers <= self.n:
             raise ValueError(
                 f"paxos_n_proposers={self.paxos_n_proposers} must be in [1, n={self.n}]"
@@ -293,7 +319,7 @@ class SimConfig:
                     f"paxos_client_ms={self.paxos_client_ms} outside the "
                     f"simulation window [0, {self.sim_ms})"
                 )
-        if self.topology == "kregular":
+        if self.topology == "gossip":
             if self.protocol not in ("paxos", "pbft", "raft"):
                 raise NotImplementedError(
                     "gossip topology is implemented for paxos (BASELINE "
@@ -311,7 +337,7 @@ class SimConfig:
                 if self.delivery != "stat":
                     raise ValueError(
                         "raft gossip rides the stat-mode value channels; "
-                        "use delivery='stat' with topology='kregular'"
+                        "use delivery='stat' with topology='gossip'"
                     )
                 # flood values encode (tick+1)*(n+1) + id, TTL-scaled by
                 # gossip_hops+1 — must fit int32
@@ -321,6 +347,57 @@ class SimConfig:
                         "overflows int32 at this size; reduce sim_ms, n, or "
                         "gossip_hops"
                     )
+        if self.topology == "kregular":
+            if self.protocol not in ("paxos", "pbft", "raft"):
+                raise NotImplementedError(
+                    "the kregular gather overlay is implemented for pbft, "
+                    "raft and paxos; the mixed shard sim keeps full-mesh "
+                    "raft inside its (small) shards by design"
+                )
+            if self.fidelity != "clean":
+                raise ValueError(
+                    "reference fidelity is defined on the full mesh only; "
+                    "the kregular overlay requires fidelity='clean' (e.g. "
+                    "the reference's N-2 paxos reply window never closes "
+                    "when a proposer reaches only k neighbors)"
+                )
+            if not 1 <= self.degree <= self.n - 1:
+                raise ValueError(
+                    f"kregular degree={self.degree} must be in [1, n-1="
+                    f"{self.n - 1}] (degree n-1 IS the full mesh)"
+                )
+        if self.topology == "committee":
+            if self.protocol not in ("paxos", "pbft", "raft"):
+                raise NotImplementedError(
+                    "committee topology runs the flat protocol per "
+                    "committee; the mixed shard sim is already a two-level "
+                    "hierarchy of its own"
+                )
+            if self.committees < 1:
+                raise ValueError(f"committees={self.committees} must be >= 1")
+            if self.n % self.committees != 0:
+                raise ValueError(
+                    f"n={self.n} must divide evenly into "
+                    f"committees={self.committees} equal committees"
+                )
+            m = self.n // self.committees
+            if m < 2:
+                raise ValueError(
+                    f"committee size n/committees = {m} must be >= 2 "
+                    "(a 1-node committee has no quorum to run)"
+                )
+            if self.protocol == "paxos" and self.paxos_n_proposers > m:
+                raise ValueError(
+                    f"paxos_n_proposers={self.paxos_n_proposers} exceeds "
+                    f"the committee size {m}: proposers are per-committee "
+                    "lanes (nodes 0..P-1 of each committee)"
+                )
+            if self.mesh_axis is not None:
+                raise ValueError(
+                    "committee topology is unsharded in this version: the "
+                    "committee axis is a lax.map, not a mesh axis "
+                    "(shard the SWEEP axis instead, parallel/partition.py)"
+                )
 
     # --- derived quantities (plain python; all static under jit) ------------
     @property

@@ -319,6 +319,15 @@ def _traced_mixed_fast(cfg: SimConfig, seed):
     return mixed.metrics(cfg, state), series
 
 
+def _reject_stacked(cfg: SimConfig) -> None:
+    if cfg.topology == "committee":
+        raise NotImplementedError(
+            "probe tracing steps the flat (state, bufs) engine; the "
+            "committee path's stacked lax.map body has no probe series "
+            "(topo/committee.py) — trace the inner committee config instead"
+        )
+
+
 def run_traced(cfg: SimConfig, seed: int | None = None):
     """Run one simulation recording a probe series.
 
@@ -340,6 +349,7 @@ def run_traced(cfg: SimConfig, seed: int | None = None):
         use_round_schedule,
     )
 
+    _reject_stacked(cfg)
     _reject_cpp_only(cfg)
     if use_round_schedule(cfg):  # raises on ineligible explicit 'round'
         if cfg.protocol == "pbft":
@@ -445,6 +455,7 @@ def profile_run(cfg: SimConfig, logdir: str, seed: int | None = None) -> dict:
     """
     from blockchain_simulator_tpu.runner import make_sim_fn
 
+    _reject_stacked(cfg)
     proto = get_protocol(cfg.protocol)
     sim = make_sim_fn(cfg)
     key = jax.random.key(cfg.seed if seed is None else seed)

@@ -102,7 +102,7 @@ def test_paxos_client_flag(capsys):
 
 def test_raft_gossip_cli(capsys):
     (m,) = run_cli(capsys, "--protocol", "raft", "--n", "64",
-                   "--sim-ms", "3000", "--topology", "kregular",
+                   "--sim-ms", "3000", "--topology", "gossip",
                    "--delivery", "stat", "--degree", "8")
     assert m["n_leaders"] == 1
     assert m["agreement_ok"]

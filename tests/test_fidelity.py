@@ -262,7 +262,7 @@ def test_queued_links_jax_gates():
     with pytest.raises(ValueError, match="topology"):
         make_sharded_sim_fn(
             SimConfig(protocol="pbft", n=512, queued_links=True,
-                      topology="kregular"),
+                      topology="gossip"),
             make_mesh(n_node_shards=4),
         )
 

@@ -13,7 +13,7 @@ from blockchain_simulator_tpu.utils.config import FaultConfig
 
 
 GCFG = SimConfig(
-    protocol="paxos", n=256, sim_ms=6000, topology="kregular",
+    protocol="paxos", n=256, sim_ms=6000, topology="gossip",
     degree=8, gossip_hops=8, paxos_retry_timeout_ms=600,
 )
 
@@ -77,12 +77,12 @@ def test_gossip_validation():
     # (votes/heartbeats, stat channels only); the mixed shard sim keeps
     # full-mesh raft inside its small shards
     with pytest.raises(ValueError, match="stat"):
-        SimConfig(protocol="raft", topology="kregular")  # delivery defaults to edge
+        SimConfig(protocol="raft", topology="gossip")  # delivery defaults to edge
     with pytest.raises(NotImplementedError, match="mixed"):
-        SimConfig(protocol="mixed", topology="kregular")
+        SimConfig(protocol="mixed", topology="gossip")
     # reference fidelity has no gossip relay
     with pytest.raises(ValueError, match="full mesh"):
-        SimConfig(protocol="paxos", topology="kregular", fidelity="reference")
+        SimConfig(protocol="paxos", topology="gossip", fidelity="reference")
     # degenerate degree
     with pytest.raises(ValueError, match="degree"):
         kregular_out_neighbors(64, 1, seed=0)
@@ -93,7 +93,7 @@ def test_gossip_validation():
 # --------------------------------------------------------------------------- #
 
 PBFT_GCFG = SimConfig(
-    protocol="pbft", n=256, sim_ms=3000, topology="kregular",
+    protocol="pbft", n=256, sim_ms=3000, topology="gossip",
     degree=8, gossip_hops=8, delivery="stat",
 )
 
@@ -155,7 +155,7 @@ def test_gossip_pbft_requires_exact_window():
 
 
 RAFT_GCFG = SimConfig(
-    protocol="raft", n=128, sim_ms=6000, topology="kregular",
+    protocol="raft", n=128, sim_ms=6000, topology="gossip",
     degree=8, gossip_hops=8, delivery="stat",
 )
 
@@ -199,12 +199,12 @@ def test_gossip_raft_serialization_off_reaches_50():
 
 def test_gossip_raft_requires_stat_and_clean():
     with pytest.raises(ValueError, match="stat"):
-        SimConfig(protocol="raft", n=64, topology="kregular", delivery="edge")
+        SimConfig(protocol="raft", n=64, topology="gossip", delivery="edge")
     with pytest.raises(ValueError, match="full mesh"):
-        SimConfig(protocol="raft", n=64, topology="kregular", delivery="stat",
+        SimConfig(protocol="raft", n=64, topology="gossip", delivery="stat",
                   fidelity="reference")
     with pytest.raises(NotImplementedError, match="mixed"):
-        SimConfig(protocol="mixed", n=64, topology="kregular")
+        SimConfig(protocol="mixed", n=64, topology="gossip")
 
 
 def test_gossip_raft_sharded_matches_unsharded():

@@ -147,7 +147,15 @@ def test_mesh_sweep_bit_equal_one_executable(single_rows):
     added = aotcache.registry.stats()["misses"] - before
     assert len(rows_mesh) == 6
     assert _rows_equal(rows_mesh, single_rows)
-    assert added == 1  # one partition-dyn-sweep entry, nothing else
+    # at most one new partition-dyn-sweep entry; 0 when an earlier test in
+    # the same process already warmed the (CANON, mesh) entry (e.g. the
+    # journaled-sweep suite) — the compile-once contract holding even
+    # harder, and the order-dependence the == 1 form flaked on.  Either
+    # way the mesh executable must EXIST in the registry (the dispatch
+    # must not have ridden a non-mesh entry)
+    assert added <= 1
+    assert aotcache.registry.stats_snapshot()["by_factory"].get(
+        "partition-dyn-sweep", 0) >= 1
 
 
 def test_mesh_sweep_uneven_grid_padding(single_rows):

@@ -138,9 +138,15 @@ def trajectory(rows: list[dict]) -> dict[str, list[dict]]:
 # The telemetry series ("telemetry_*" from tools/telemetry_report.py —
 # span-completeness misses, wall-time coverage pct, overhead pct) follow
 # the same rule: the report's own gates are its exit code.
+# The topology series ("topo_*" from tools/topo_bench.py — kregular ladder
+# ticks/s, committee completion rates) are chart-only UNTIL a committed
+# baseline exists: the ladder's rungs vary with --max-n / box state, and
+# the bench's own acceptance (equality pins + largest-rung completion) is
+# its exit code.  Promote to gated once ARTIFACT_topo_scale.json has a
+# stable successor to compare against.
 UNGATED_SUFFIXES = ("_findings", "_compile_s", "_p50_ms")
 UNGATED_PREFIXES = ("graph_", "chaos_", "fleet_", "journal_", "resume_",
-                    "telemetry_")
+                    "telemetry_", "topo_")
 
 # Serving latency is lower-is-better AND gated: the serve smoke/bench land
 # a p99 trajectory (serve_p99_ms) whose REGRESSION is an increase, so the

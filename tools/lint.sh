@@ -168,6 +168,24 @@ if [ "${TICK:-1}" != "0" ]; then
     fi
 fi
 
+# Topology smoke (tools/topo_bench.py --quick): the sparse-axis
+# correctness pins — kregular(k=N-1) bit-equal to dense per protocol,
+# committee C=1 contains the flat metrics — plus one genuinely sparse
+# kregular rung compiled and run end to end (ops/gatherdeliv.py).  The
+# full-scale ladder (10k/100k/1M + the dense-vs-sparse 10k ratio) is
+# `python tools/topo_bench.py` and the committed ARTIFACT_topo_scale.json;
+# topo_* series are chart-only in bench_compare until a baseline exists.
+# TOPO=0 skips (~1 min of small compiles on this box).
+if [ "${TOPO:-1}" != "0" ]; then
+    echo "== topo smoke =="
+    python tools/topo_bench.py --quick
+    topo_rc=$?
+    if [ "$topo_rc" -ne 0 ]; then
+        echo "lint.sh: topo smoke FAILED (rc=$topo_rc)" >&2
+        rc=1
+    fi
+fi
+
 # Telemetry report (tools/telemetry_report.py --quick): a real in-process
 # fleet drill (router -> replica -> batcher -> dispatch) with spans
 # captured — every admitted id must have a closed span tree and the named

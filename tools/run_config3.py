@@ -1,4 +1,4 @@
-"""BASELINE config 3: Paxos, 10k nodes, random-graph gossip (kregular),
+"""BASELINE config 3: Paxos, 10k nodes, random-graph gossip (topology="gossip"),
 adjacency/node state sharded over the available device mesh.  Writes
 ARTIFACT_config3.json at the repo root.
 
@@ -47,7 +47,7 @@ def main() -> None:
     sim_ms = int(_sys.argv[2]) if len(_sys.argv) > 2 else 3000
     degree = int(_sys.argv[3]) if len(_sys.argv) > 3 else 16
     cfg = SimConfig(
-        protocol="paxos", n=n, sim_ms=sim_ms, topology="kregular",
+        protocol="paxos", n=n, sim_ms=sim_ms, topology="gossip",
         degree=degree, delivery="stat", model_serialization=False,
         # clean-fidelity retry windows must cover the full flood + reply
         # horizon: (gossip_hops + 2) * delay_hi = 10 * 53 = 530 ms at the

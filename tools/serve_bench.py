@@ -103,7 +103,38 @@ def main(argv=None) -> int:
     p.add_argument("--latency-requests", type=int, default=60)
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--max-wait-ms", type=float, default=10.0)
+    p.add_argument("--mesh-sweep", type=int, default=0,
+                   help="when > 1, re-run the capacity phase on a second "
+                        "server dispatching through the mesh-partitioned "
+                        "sweep executable (sweep axis width N) and record "
+                        "the daemon-default decision (>20%% margin rule, "
+                        "KNOWN_ISSUES #0j) IN THIS artifact — the n=1024 "
+                        "measurement the fleet bench's n=8 one deferred to")
     args = p.parse_args(argv)
+
+    if args.mesh_sweep and args.mesh_sweep > 1:
+        # virtual CPU devices for the mesh leg — must land before the
+        # first jax import (host device count is read at backend init;
+        # tools/mesh_sweep_bench.py sets it the same way).  A preset flag
+        # too small for the requested mesh cannot be overridden post-init:
+        # fail fast HERE rather than after the plain phases have run
+        import re as _re
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        m = _re.search(r"xla_force_host_platform_device_count=(\d+)", flags)
+        if m is None:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={args.mesh_sweep}"
+            ).strip()
+        elif int(m.group(1)) < args.mesh_sweep:
+            print(
+                f"serve_bench: XLA_FLAGS presets "
+                f"{m.group(1)} host devices < --mesh-sweep "
+                f"{args.mesh_sweep}; unset it or raise the count",
+                file=_sys.stderr,
+            )
+            return 2
 
     import jax
 
@@ -161,7 +192,8 @@ def main(argv=None) -> int:
             bit_equal = bit_equal and _norm(solo) == _norm(resp["metrics"])
 
     # ---- warm phases: open-loop traffic against warm executables --------
-    def open_loop(rate, count, seed0):
+    def open_loop(rate, count, seed0, srv=None):
+        srv = server if srv is None else srv
         pending = []
         interval = 1.0 / rate if rate > 0 else 0.0
 
@@ -173,7 +205,7 @@ def main(argv=None) -> int:
                     faults={"n_byzantine": f_levels[i % len(f_levels)]},
                 )
                 try:
-                    pending.append(server.submit(obj))
+                    pending.append(srv.submit(obj))
                 except Exception:
                     pending.append(None)  # counted as a lost lane below
                 time.sleep(interval)
@@ -201,6 +233,39 @@ def main(argv=None) -> int:
     lat = [r["latency_ms"] for r in lat_ok]
     stats = server.stats()
     server.close()
+
+    # ---- optional mesh-dispatch comparison leg (--mesh-sweep N) ---------
+    # same template, same capacity workload, dispatched through the
+    # mesh-partitioned sweep executable (parallel/partition.py; the #0i
+    # scatter-free per-device lax.map body) — the n=1024 measurement the
+    # KNOWN_ISSUES #0j decision rule asked for before flipping the
+    # daemon's --mesh-sweep default
+    mesh_leg = None
+    if args.mesh_sweep and args.mesh_sweep > 1:
+        from blockchain_simulator_tpu.parallel.mesh import make_mesh
+
+        mesh_srv = ScenarioServer(
+            max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+            max_queue=max(4 * args.max_batch, args.requests),
+            mesh=make_mesh(n_node_shards=1, n_sweep=args.mesh_sweep),
+        )
+        t0 = time.monotonic()
+        mesh_srv.prewarm(template)
+        mesh_cold_s = time.monotonic() - t0
+        mesh_responses, mesh_wall = open_loop(
+            args.rate, args.requests, 9000, srv=mesh_srv)
+        mesh_srv.close()
+        mesh_ok = [r for r in mesh_responses if r.get("status") == "ok"]
+        mesh_rps = (round(len(mesh_ok) / mesh_wall, 2)
+                    if mesh_wall > 0 else None)
+        mesh_leg = {
+            "mesh_sweep": args.mesh_sweep,
+            "prewarm_s": round(mesh_cold_s, 2),
+            "served": len(mesh_ok),
+            "errors": len(mesh_responses) - len(mesh_ok),
+            "capacity_wall_s": round(mesh_wall, 2),
+            "rps": mesh_rps,
+        }
 
     drill = run_drill()
 
@@ -248,6 +313,23 @@ def main(argv=None) -> int:
         "drill": drill,
         "registry": aotcache.registry.stats_snapshot(),
     }
+    if mesh_leg is not None:
+        plain, meshed = rps, mesh_leg["rps"]
+        rec["mesh_leg"] = mesh_leg
+        rec["mesh_sweep_decision"] = {
+            "plain_rps": plain,
+            "meshed_rps": meshed,
+            "mesh": args.mesh_sweep,
+            # the fleet bench's displacement rule, now at the n=1024 path:
+            # mesh dispatch must beat single-device by a real margin to
+            # displace the simpler default
+            "rule": "meshed > 1.2 * plain",
+            "default": "mesh-sweep"
+            if plain and meshed and meshed > 1.2 * plain
+            else "single-device",
+        }
+        obs.finalize({"metric": "serve_bench_mesh_rps", "value": meshed,
+                      "unit": "req/s"})
     with open(ARTIFACT, "w") as f:
         json.dump(rec, f, indent=1)
         f.write("\n")

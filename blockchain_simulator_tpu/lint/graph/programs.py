@@ -426,6 +426,47 @@ def build_catalog() -> list[ProgramSpec]:
         "shard.mixed_fast", "shard-mixed",
         lambda: _raw(_shard_mod()._make_sharded_mixed_fast_fn), "mixed_fast"))
 
+    # --- parallel/sweep.sharded_topo_sim_fn ("shard-topo-sim") ----------
+    # The node-dim-sharded overlay programs (ISSUE 16).  The kregular arm
+    # is audited through ``sim.partitioned`` + ``sim.table_avals`` — the
+    # pjit callable with the [N, K+1] overlay tables as OPERANDS — so the
+    # traced jaxpr proves the tables stopped being baked constants
+    # (large-jaxpr-constant stays clean by construction, not by waiver).
+    # Divergence twins: fault counts over one kregular overlay must trace
+    # to ONE fingerprint per mesh (the one-executable-per-(protocol,
+    # topology, fault structure, mesh) registry pin).
+    def shard_topo_spec(name, arm, fc_kw, group, budget):
+        def build():
+            import dataclasses as _dc
+
+            from blockchain_simulator_tpu.models.base import canonical_fault_cfg
+            from blockchain_simulator_tpu.parallel import sweep
+
+            cfg = cfgs[arm]
+            if fc_kw:
+                cfg = cfg.with_(faults=_dc.replace(cfg.faults, **fc_kw))
+            sim = _raw(sweep.sharded_topo_sim_fn)(
+                canonical_fault_cfg(cfg), _audit_mesh()
+            )
+            args = (_key_sds(), _i32_sds(), _i32_sds())
+            if hasattr(sim, "partitioned"):
+                return sim.partitioned, args + tuple(sim.table_avals)
+            return sim, args
+
+        return ProgramSpec(name, "shard-topo-sim", build,
+                           divergence_group=group, budget=budget)
+
+    specs.append(shard_topo_spec("shard_topo.pbft_kreg", "pbft_kreg",
+                                 {"n_crashed": 1}, "shard-topo:pbft_kreg",
+                                 True))
+    specs.append(shard_topo_spec("shard_topo.pbft_kreg_c2", "pbft_kreg",
+                                 {"n_crashed": 2}, "shard-topo:pbft_kreg",
+                                 False))
+    specs.append(shard_topo_spec("shard_topo.raft_kreg", "raft_kreg",
+                                 {}, None, True))
+    specs.append(shard_topo_spec("shard_topo.pbft_comm", "pbft_comm",
+                                 {"n_crashed": 1}, None, True))
+
     # --- utils/trace.py factories ---------------------------------------
     def build_trace_tick():
         from blockchain_simulator_tpu.utils import trace

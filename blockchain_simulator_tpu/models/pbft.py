@@ -238,7 +238,7 @@ def _scatter_window_events(acc_add, acc_max, acc_min, events, eff_sid, t, s):
     return out
 
 
-def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
+def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey, *, topo_tables=None):
     n, s = cfg.n, cfg.pbft_max_slots
     w = eff_window(cfg)
     exact = w == s
@@ -313,7 +313,10 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey):
     kreg = cfg.topology == "kregular"
     nbr_in_loc = nbr_out_loc = None
     if kreg:
-        nbr_in_loc, nbr_out_loc = gd.local_tables(cfg, ids)
+        # topo_tables=None bakes the tables as trace constants (audit
+        # scale); the sharded programs pass them as operands instead
+        nbr_in_loc, nbr_out_loc = gd.local_tables(cfg, ids,
+                                                  tables=topo_tables)
     seen_pp, seen_vc = state.seen_pp, state.seen_vc
     pp_fwd = vc_fwd = None
     nbrs_loc = None

@@ -214,7 +214,7 @@ def init(cfg, key=None):
 
 
 
-def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
+def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey, *, topo_tables=None):
     n = cfg.n
     axis = cfg.mesh_axis
     lo, hi = cfg.one_way_range()
@@ -274,7 +274,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey):
     nbr_in_loc = nbr_out_loc = inslot_loc = None
     if kreg:
         nbr_in_loc, nbr_out_loc, inslot_loc = gd.local_tables(
-            cfg, ids, inslot=True)
+            cfg, ids, inslot=True, tables=topo_tables)
     seen_vreq, seen_hb, seen_prop = state.seen_vreq, state.seen_hb, state.seen_prop
     vreq_fwd = hb_fwd = prop_fwd = None
     nbrs_loc = None

@@ -21,9 +21,15 @@ over the same index set — so the sparse program's integer channel values
 
 SPMD: same convention as ops/delivery.py — receiver rows stay local,
 sender-side quantities globalize with ``all_gather`` (``axis`` is the mesh
-axis name; None = unsharded).  The tables are static trace constants
-sliced to local rows by the caller (models pass ``nbr[ids]``), exactly
-like the gossip arm's ``nbrs_loc``.
+axis name; None = unsharded).  The tables reach the primitives in one of
+two ways: as static trace constants sliced to local rows by the caller
+(models pass ``nbr[ids]``, exactly like the gossip arm's ``nbrs_loc`` —
+fine at audit scale), or as real program OPERANDS
+(:func:`table_operands` + the ``tables=`` argument of
+:func:`local_tables`) so multi-MB overlays never bake into the jaxpr and
+the mesh-sharded programs can shard them over the node dimension
+(KNOWN_ISSUES #0n's escape hatch, implemented by parallel/sweep.py's
+``sharded_topo_sim_fn``).
 """
 
 from __future__ import annotations
@@ -43,18 +49,38 @@ from blockchain_simulator_tpu.ops.delay import (
 # ------------------------------------------------------------- tables -------
 
 
-def local_tables(cfg, ids, inslot: bool = False):
-    """The overlay tables of ``cfg``, sliced to this shard's rows: ``(in,
-    out)`` or ``(in, out, inslot)`` — the one localization call site the
-    three models share (the tables are trace constants; ``ids`` is the
-    shard's global row ids, so unsharded this is the whole table)."""
+def table_operands(cfg, inslot: bool = False):
+    """The full overlay tables of ``cfg`` as host numpy arrays — ``(in,
+    out)`` or ``(in, out, inslot)``, each int32 ``[N, K]`` — for feeding a
+    program as real OPERANDS instead of letting :func:`local_tables` bake
+    them into the jaxpr (multi-MB constants at large n, the
+    large-jaxpr-constant graph rule).  Deterministic in ``(n, degree,
+    topo_seed)`` so one device_put per registry entry suffices."""
     from blockchain_simulator_tpu.topo import spec as topo_spec
 
     args = (cfg.n, cfg.degree, cfg.topo_seed)
     tabs = [topo_spec.in_table(*args), topo_spec.out_table(*args)]
     if inslot:
         tabs.append(topo_spec.inslot_table(*args))
-    return tuple(jnp.take(jnp.asarray(t), ids, axis=0) for t in tabs)
+    return tuple(tabs)
+
+
+def local_tables(cfg, ids, inslot: bool = False, tables=None):
+    """The overlay tables of ``cfg``, sliced to this shard's rows: ``(in,
+    out)`` or ``(in, out, inslot)`` — the one localization call site the
+    three models share.  ``ids`` is the shard's global row ids, so
+    unsharded this is the whole table.  With ``tables=None`` the tables
+    are trace constants (the audit-scale default); passing the
+    :func:`table_operands` arrays (possibly tracers) keeps them program
+    operands — same values, same gather, no baked constant."""
+    if tables is None:
+        tables = table_operands(cfg, inslot=inslot)
+    elif len(tables) != (3 if inslot else 2):
+        raise ValueError(
+            f"local_tables: expected {3 if inslot else 2} tables for "
+            f"inslot={inslot}, got {len(tables)}"
+        )
+    return tuple(jnp.take(jnp.asarray(t), ids, axis=0) for t in tables)
 
 
 # ------------------------------------------------------------ gather sums ---

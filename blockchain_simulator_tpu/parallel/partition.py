@@ -216,6 +216,31 @@ def partition(fn, mesh, *, in_shardings=None, out_shardings=None,
     return jax.jit(shmapped)  # jaxlint: disable=static-arg-recompile-hazard
 
 
+# ----------------------------------------------------- node-dim rule sets ---
+
+
+def node_dim_rules(replicated_names=()):
+    """``((regex, spec), ...)`` declaring: the named leaves replicate,
+    every other non-scalar leaf shards dim 0 over the nodes axis.
+
+    The one rule shape every node-dim consumer shares
+    (:func:`match_partition_rules` turns it into full specs per tree):
+    the sharded sim wrappers' per-node state (parallel/shard.state_rules
+    passes the protocol's ``GLOBAL_FIELDS``), the kregular ``[N, K]``
+    overlay-table operands and unbatched ``[N, ...]`` finals, and the
+    committee path's ``[C, ...]`` stacked finals (dim 0 is the committee
+    axis — the hierarchy's node-dim analog) in
+    parallel/sweep.sharded_topo_sim_fn."""
+    from blockchain_simulator_tpu.parallel.mesh import NODES_AXIS
+
+    P = _spec_cls()
+    rules = tuple(
+        (rf"(^|/){re.escape(name)}$", REPLICATED)
+        for name in replicated_names
+    )
+    return rules + ((r".*", P(NODES_AXIS)),)
+
+
 # ----------------------------------------------------- mesh-sweep helpers ---
 
 
@@ -291,8 +316,10 @@ def batched_out_shardings(cfg, mesh, out_avals):
     ``[B, C, m, ...]`` (topo/committee.py) — there dim 1 is the COMMITTEE
     axis, the node-dim analog of the hierarchy, and it rides the nodes
     axis when it divides evenly; kregular finals keep the flat ``[B, N,
-    ...]`` shape and need no new rule (its index tables are per-shard
-    trace constants sliced by local ids, like the gossip arm's)."""
+    ...]`` shape and the same dim-1 rule applies.  The UNBATCHED topo
+    programs (sweep.sharded_topo_sim_fn) don't come through here: their
+    node dim is dim 0 and their overlay tables are real operands —
+    :func:`node_dim_rules` declares those."""
     import jax
 
     from blockchain_simulator_tpu.parallel.mesh import NODES_AXIS, SWEEP_AXIS

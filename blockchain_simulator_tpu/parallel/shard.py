@@ -21,8 +21,6 @@ by the graph audit (lint/graph/programs.py ``shard.*`` specs).
 
 from __future__ import annotations
 
-import re
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
@@ -38,14 +36,12 @@ from blockchain_simulator_tpu.utils.config import SimConfig
 
 # Node state [N, ...]: row-shard dim 0 — except the protocol's
 # ``GLOBAL_FIELDS`` (per-slot accumulators): replicated, each shard carries
-# a partial that the protocol's ``finalize`` combines.
+# a partial that the protocol's ``finalize`` combines.  The rule set itself
+# lives in the partition layer (partition.node_dim_rules) — the sharded
+# topo programs (parallel/sweep.sharded_topo_sim_fn) declare theirs from
+# the same helper.
 def state_rules(global_fields=()):
-    rules = []
-    if global_fields:
-        names = "|".join(re.escape(f) for f in global_fields)
-        rules.append((rf"(^|/)({names})$", partition.REPLICATED))
-    rules.append((r".*", P(NODES_AXIS)))
-    return tuple(rules)
+    return partition.node_dim_rules(global_fields)
 
 
 # Ring/delivery buffers [D, N, ...]: the node axis is dim 1.

@@ -47,6 +47,7 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from blockchain_simulator_tpu.chaos import inject
 from blockchain_simulator_tpu.models.base import canonical_fault_cfg, sim_metrics
@@ -58,6 +59,8 @@ from blockchain_simulator_tpu.runner import (
     check_batchable,
     make_dyn_sim_fn,
     make_sim_fn,
+    make_topo_dyn_sim_fn,
+    topo_tables_inslot,
 )
 from blockchain_simulator_tpu.utils import aotcache, obs, telemetry
 from blockchain_simulator_tpu.utils.config import SimConfig
@@ -154,6 +157,196 @@ def mesh_dyn_batched_fn(cfg: SimConfig, mesh):
     return partition.partition(
         body, mesh, in_specs=(lane, lane, lane), out_specs=lane
     )
+
+
+@aotcache.cached_factory("shard-topo-sim")
+def sharded_topo_sim_fn(cfg: SimConfig, mesh):
+    """Node-dim mesh-sharded topology program: ``sim(key, n_crashed,
+    n_byzantine) -> final_state`` for a kregular or committee config with
+    the overlay partitioned over the mesh's ``nodes`` axis — the 10M-node
+    arm of ROADMAP item 3 (the [N, K] tables and per-edge tensors stop
+    living on one device).  ``cfg`` must already be fault-canonical
+    (models/base.canonical_fault_cfg — the :func:`run_sharded_topo` /
+    bench callers canonicalize): ONE registry entry per (protocol,
+    topology, fault structure, mesh), fault counts ride the operands.
+
+    Three arms:
+
+    - **mesh of size 1**: ``jax.jit(make_dyn_sim_fn(cfg))`` — literally
+      the single-device program (tables as trace constants, the PR 15
+      path), so the degenerate case is bit-identical by construction.
+    - **kregular, nodes > 1**: the explicit-sharding pjit arm.  The body
+      is ``runner.make_topo_dyn_sim_fn`` — the tick engine with the
+      ``[N, K]`` overlay tables as real OPERANDS (ops/gatherdeliv.
+      table_operands; KNOWN_ISSUES #0n's escape hatch) — compiled through
+      ``partition.partition`` with the tables and every node-dim final
+      sharded ``P(NODES_AXIS)`` (partition.node_dim_rules; the protocol's
+      ``GLOBAL_FIELDS`` replicate).  The model traces in global view
+      (``cfg.mesh_axis`` stays None) so the cross-shard neighbor reads
+      stay plain ``jnp.take`` gathers for XLA GSPMD to partition — the
+      traced computation is identical to the single-device program, hence
+      bit-equal results under the exact sampler (tests/test_zzshardtopo).
+      The sharded tables are device_put once per factory call and closed
+      over; ``sim.partitioned`` / ``sim.table_avals`` expose the inner
+      pjit callable and table avals so the graph audit traces the
+      tables-as-operands jaxpr (zero large-jaxpr-constant findings).
+      Uneven ``n % shards`` is fine: explicit NamedShardings must divide
+      evenly in this jax, so the factory zero-pads the table rows to the
+      next multiple (the wrapper slices them back before the engine sees
+      them — padding rows are never read) and any final whose node dim
+      stays uneven replicates instead of sharding.
+    - **committee, nodes > 1**: shard_map over the STACKED committee axis
+      (``committees % shards == 0`` required): each device runs
+      ``topo/committee.stacked_body`` — the same ``lax.map`` of the
+      unvmapped inner engine — on its slice of the ``[C]`` key stack and
+      ``[C, m]`` fault masks.  Committee bodies never communicate before
+      the host-side outer aggregate, and the per-committee keys are
+      computed from the GLOBAL committee index before the shard_map, so
+      every lane's stream matches the single-device program bit for bit.
+      ``cfg.mesh_axis`` stays None (utils/config.py pins committee configs
+      unsharded at the NODE level — this arm shards the committee STACK,
+      which is the hierarchy's node-dim analog)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from blockchain_simulator_tpu.models import base as base_model
+    from blockchain_simulator_tpu.ops import gatherdeliv as gd
+
+    if cfg.topology not in ("kregular", "committee"):
+        raise ValueError(
+            f"sharded_topo_sim_fn shards the sparse/hierarchical overlays; "
+            f"topology={cfg.topology!r} has no node-dim topo structure "
+            "(dense configs ride parallel/shard.py, gossip is unsharded)"
+        )
+    if partition.mesh_size(mesh) == 1:
+        return jax.jit(make_dyn_sim_fn(cfg))
+    n_shards = int(dict(mesh.shape).get(NODES_AXIS, 1))
+    if n_shards <= 1:
+        raise ValueError(
+            "sharded_topo_sim_fn partitions the node dimension: the mesh "
+            f"needs nodes > 1 (got shape {dict(mesh.shape)}); sweep-axis "
+            "meshes belong to mesh_dyn_batched_fn"
+        )
+
+    if cfg.topology == "committee":
+        from blockchain_simulator_tpu.topo import committee
+
+        c, m = cfg.committees, cfg.n // cfg.committees
+        if c % n_shards != 0:
+            raise ValueError(
+                f"committees={c} not divisible by {n_shards} node shards "
+                "(the committee stack shards whole committees)"
+            )
+
+        def body(keys, alive_cm, honest_cm):
+            return committee.stacked_body(cfg, keys, alive_cm, honest_cm)
+
+        keys_sds = jax.eval_shape(
+            lambda: committee._committee_keys(jax.random.key(0), c)
+        )
+        mask_sds = jax.eval_shape(
+            lambda: jax.tree.map(
+                lambda x: x.reshape(c, m),
+                base_model.dyn_fault_masks(cfg.n, jnp.int32(0), jnp.int32(0)),
+            )
+        )
+        outs = jax.eval_shape(body, keys_sds, *mask_sds)
+        out_specs = partition.match_partition_rules(
+            partition.node_dim_rules(), outs
+        )
+        lane = P(NODES_AXIS)
+        shmapped = partition.partition(
+            body, mesh, in_specs=(lane, lane, lane), out_specs=out_specs,
+            wrap_jit=False,
+        )
+
+        @jax.jit
+        def sim(key, n_crashed, n_byzantine):
+            alive, honest = base_model.dyn_fault_masks(
+                cfg.n, n_crashed, n_byzantine
+            )
+            keys = committee._committee_keys(key, c)
+            return shmapped(keys, alive.reshape(c, m), honest.reshape(c, m))
+
+        return sim
+
+    inner_fn = make_topo_dyn_sim_fn(cfg)
+    proto = base_model.get_protocol(cfg.protocol)
+    tables = gd.table_operands(cfg, inslot=topo_tables_inslot(cfg))
+    # explicit NamedShardings must divide evenly (jax 0.4 pjit aval
+    # check) — zero-pad the table rows to the next multiple of the shard
+    # count and slice back inside the program (pad rows are never read:
+    # every gather indexes ids < n)
+    pad = (-cfg.n) % n_shards
+    if pad:
+        tables = tuple(
+            np.pad(t, ((0, pad),) + ((0, 0),) * (t.ndim - 1))
+            for t in tables
+        )
+
+        def fn(key, n_crashed, n_byzantine, *tabs):
+            return inner_fn(
+                key, n_crashed, n_byzantine, *(t[: cfg.n] for t in tabs)
+            )
+    else:
+        fn = inner_fn
+    tab_sds = tuple(
+        jax.ShapeDtypeStruct(t.shape, jnp.int32) for t in tables
+    )
+    key_sds = jax.eval_shape(lambda: jax.random.key(0))
+    cnt_sds = jax.ShapeDtypeStruct((), jnp.int32)
+    outs = jax.eval_shape(fn, key_sds, cnt_sds, cnt_sds, *tab_sds)
+    out_shardings = partition.match_partition_rules(
+        partition.node_dim_rules(getattr(proto, "GLOBAL_FIELDS", ())), outs
+    )
+    # finals whose node dim stays uneven can't carry an explicit sharded
+    # spec either — replicate those leaves (uneven n only)
+    out_shardings = jax.tree.map(
+        lambda spec, aval: (
+            P()
+            if spec
+            and spec[0] == NODES_AXIS
+            and aval.shape[0] % n_shards != 0
+            else spec
+        ),
+        out_shardings,
+        outs,
+        is_leaf=lambda x: x is None or isinstance(x, P),
+    )
+    table_spec = P(NODES_AXIS)
+    p = partition.partition(
+        fn, mesh,
+        in_shardings=(P(), P(), P()) + (table_spec,) * len(tables),
+        out_shardings=out_shardings,
+    )
+    ns = NamedSharding(mesh, table_spec)
+    tables_dev = tuple(jax.device_put(t, ns) for t in tables)
+
+    def sim(key, n_crashed, n_byzantine):
+        return p(key, n_crashed, n_byzantine, *tables_dev)
+
+    # audit hooks: the graph specs trace `partitioned` with `table_avals`
+    # as arguments, so the audited jaxpr carries the tables as operands —
+    # the runtime closure above never re-bakes them either (device arrays)
+    sim.partitioned = p
+    sim.table_avals = tab_sds
+    return sim
+
+
+def run_sharded_topo(cfg: SimConfig, mesh, seed: int | None = None):
+    """Run one kregular/committee simulation node-dim-sharded over
+    ``mesh`` (:func:`sharded_topo_sim_fn`); returns the same metrics dict
+    as ``runner.run_simulation`` — bit-equal to it under the exact sampler
+    at any mesh size (the tables-as-operands computation is identical and
+    the committee stack shards whole committees)."""
+    canon = canonical_fault_cfg(cfg)
+    sim = sharded_topo_sim_fn(canon, mesh)
+    nc = cfg.faults.resolved_n_crashed(cfg.n)
+    nb = cfg.faults.n_byzantine
+    key = jax.random.key(cfg.seed if seed is None else seed)
+    final = jax.block_until_ready(
+        sim(key, jnp.int32(nc), jnp.int32(nb))
+    )
+    return sim_metrics(cfg, final)
 
 
 @aotcache.cached_factory("multi-seed-tick")

@@ -332,6 +332,71 @@ def make_dyn_sim_fn(cfg: SimConfig):
     return sim
 
 
+def topo_tables_inslot(cfg: SimConfig) -> bool:
+    """Does this protocol's kregular arm consume the ``inslot`` cross-index
+    (three tables) or just the in/out pair (two)?  The one place the
+    operand-feeding callers (parallel/sweep.sharded_topo_sim_fn, the graph
+    audit specs) learn the table arity."""
+    return cfg.protocol == "raft"
+
+
+def make_topo_dyn_sim_fn(cfg: SimConfig):
+    """The tables-as-operands twin of :func:`make_dyn_sim_fn` for the
+    kregular overlay: ``sim(key, n_crashed, n_byzantine, *tables) ->
+    final_state`` where ``tables`` are the full ``[N, K]`` int32 overlay
+    tables (ops/gatherdeliv.table_operands — ``(in, out)``, plus
+    ``inslot`` for raft; :func:`topo_tables_inslot`).  Feeding them as
+    arguments instead of letting the trace bake them keeps multi-MB
+    overlays out of the jaxpr (KNOWN_ISSUES #0n's escape hatch, the
+    large-jaxpr-constant graph rule) and lets parallel/sweep.py's
+    ``sharded_topo_sim_fn`` shard them over the mesh's node axis.
+
+    Same trace contract as ``make_dyn_sim_fn``: ``cfg`` is canonicalized,
+    the function is returned UNJITTED (the caller owns the jit/pjit
+    wrapper), and at equal table values the computation is identical —
+    ``jnp.take(tables[i], ids)`` sees the same numbers whether the table
+    is an operand or a constant, so results are bit-equal under the exact
+    sampler (pinned in tests/test_zzshardtopo.py)."""
+    cfg = base_model.canonical_fault_cfg(cfg)
+    check_batchable(cfg)
+    _reject_cpp_only(cfg)
+    if cfg.topology != "kregular":
+        raise ValueError(
+            f"make_topo_dyn_sim_fn is the kregular tables-as-operands "
+            f"program; topology={cfg.topology!r} has no overlay tables "
+            "(committee shards its stacked axis instead — parallel/sweep."
+            "sharded_topo_sim_fn routes it)"
+        )
+    use_round_schedule(cfg)  # validates schedule='round' (kregular: tick)
+    n = cfg.n
+    n_tables = 3 if topo_tables_inslot(cfg) else 2
+    proto = get_protocol(cfg.protocol)
+
+    def sim(key, n_crashed, n_byzantine, *tables):
+        if len(tables) != n_tables:
+            raise ValueError(
+                f"{cfg.protocol} kregular sim takes {n_tables} overlay "
+                f"tables, got {len(tables)}"
+            )
+        state, bufs = proto.init(cfg, jax.random.fold_in(key, 0x1217))
+        state = base_model.apply_fault_masks(
+            cfg, state, *base_model.dyn_fault_masks(n, n_crashed, n_byzantine)
+        )
+
+        def body(carry, t):
+            st, bf = carry
+            st, bf = proto.step(cfg, st, bf, t, prng.tick_key(key, t),
+                                topo_tables=tables)
+            return (st, bf), ()
+
+        (state, bufs), _ = jax.lax.scan(
+            body, (state, bufs), jnp.arange(cfg.ticks)
+        )
+        return state
+
+    return sim
+
+
 def run_simulation(cfg: SimConfig, seed: int | None = None, with_timing: bool = False):
     """Run one simulation; returns the protocol's structured metrics dict
     (the reference's NS_LOG lines, SURVEY.md §5, as data).

@@ -60,17 +60,17 @@ def _committee_keys(key, c: int):
     return jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(c))
 
 
-def run_stacked(cfg, key, n_crashed, n_byzantine):
-    """Traced committee sim: ``(key, n_crashed, n_byzantine) -> stacked
-    final state [C, ...]`` — the dynamic-fault-operand program
-    (runner.make_dyn_sim_fn committee arm; the static arm passes the
-    config's own counts).  ``cfg`` must already be fault-canonical, like
-    every dyn program (models/base.canonical_fault_cfg)."""
+def stacked_body(cfg, keys, alive_cm, honest_cm):
+    """The committee batch body: ``lax.map`` of the unvmapped inner tick
+    engine over whatever leading committee axis the inputs carry —
+    ``keys [c']``, ``alive_cm/honest_cm [c', m]`` -> stacked final state
+    ``[c', ...]``.  Shared verbatim by :func:`run_stacked` (c' = C, one
+    device) and the mesh arm (parallel/sweep.sharded_topo_sim_fn:
+    shard_map hands each device its C/n_shards slice — the body never
+    needs to know, there is no cross-committee communication before the
+    host-side outer aggregate in :func:`metrics`)."""
     proto = base_model.get_protocol(cfg.protocol)
-    c, m = cfg.committees, cfg.n // cfg.committees
     icfg = inner_cfg(cfg)
-    alive, honest = base_model.dyn_fault_masks(cfg.n, n_crashed, n_byzantine)
-    keys = _committee_keys(key, c)
 
     def body(args):
         kc, alive_c, honest_c = args
@@ -87,7 +87,19 @@ def run_stacked(cfg, key, n_crashed, n_byzantine):
         )
         return state
 
-    return jax.lax.map(body, (keys, alive.reshape(c, m), honest.reshape(c, m)))
+    return jax.lax.map(body, (keys, alive_cm, honest_cm))
+
+
+def run_stacked(cfg, key, n_crashed, n_byzantine):
+    """Traced committee sim: ``(key, n_crashed, n_byzantine) -> stacked
+    final state [C, ...]`` — the dynamic-fault-operand program
+    (runner.make_dyn_sim_fn committee arm; the static arm passes the
+    config's own counts).  ``cfg`` must already be fault-canonical, like
+    every dyn program (models/base.canonical_fault_cfg)."""
+    c, m = cfg.committees, cfg.n // cfg.committees
+    alive, honest = base_model.dyn_fault_masks(cfg.n, n_crashed, n_byzantine)
+    keys = _committee_keys(key, c)
+    return stacked_body(cfg, keys, alive.reshape(c, m), honest.reshape(c, m))
 
 
 def milestone_ms(protocol: str, inner_metrics: dict) -> float:

@@ -139,14 +139,23 @@ def trajectory(rows: list[dict]) -> dict[str, list[dict]]:
 # span-completeness misses, wall-time coverage pct, overhead pct) follow
 # the same rule: the report's own gates are its exit code.
 # The topology series ("topo_*" from tools/topo_bench.py — kregular ladder
-# ticks/s, committee completion rates) are chart-only UNTIL a committed
-# baseline exists: the ladder's rungs vary with --max-n / box state, and
-# the bench's own acceptance (equality pins + largest-rung completion) is
-# its exit code.  Promote to gated once ARTIFACT_topo_scale.json has a
-# stable successor to compare against.
+# ticks/s, committee completion rates) are chart-only by prefix, PROMOTED
+# to gated per metric through BENCH_BASELINES.json: a metric with a
+# committed baseline row always gates (the baseline is its first
+# trajectory point), prefix carve-out or not.  The shard_topo full-run
+# series ("shard_topo_full_*" from tools/shard_topo_bench.py) follows the
+# topo_ rationale — full-scale rungs vary with --env-n / box state and
+# the bench's own acceptance is its exit code — while the smoke-scale
+# "shard_topo_ticks_per_s" (lint.sh chain) gates by default.
 UNGATED_SUFFIXES = ("_findings", "_compile_s", "_p50_ms")
 UNGATED_PREFIXES = ("graph_", "chaos_", "fleet_", "journal_", "resume_",
-                    "telemetry_", "topo_")
+                    "telemetry_", "topo_", "shard_topo_full_")
+
+# Committed per-metric baselines: the first trajectory row of each listed
+# metric, pinned in-repo so a series without a second runs.jsonl sample
+# still has a predecessor to gate against.  Committing a baseline is the
+# promotion act for an UNGATED_PREFIXES series.
+BASELINES = os.path.join(REPO, "BENCH_BASELINES.json")
 
 # Serving latency is lower-is-better AND gated: the serve smoke/bench land
 # a p99 trajectory (serve_p99_ms) whose REGRESSION is an increase, so the
@@ -169,14 +178,46 @@ def compile_s_rows(rows: list[dict]) -> list[dict]:
     ]
 
 
-def check_regressions(by_metric: dict, threshold: float) -> list[str]:
+def load_baselines(path: str = BASELINES) -> list[dict]:
+    """Committed baseline rows (one per metric), or [] when the file is
+    absent.  Each row charts as source ``BENCH_BASELINES.json`` and seeds
+    its metric's trajectory, which also GATES the metric regardless of the
+    prefix carve-outs (see check_regressions)."""
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except OSError:
+        return []
+    return [
+        {
+            "source": os.path.basename(path),
+            "round": None,
+            "rc": 0,
+            "metric": metric,
+            "value": pin.get("value"),
+            "backend": pin.get("backend"),
+            "rounds": None,
+            "wall_s": None,
+            "compile_s": None,
+        }
+        for metric, pin in sorted(rec.get("baselines", {}).items())
+    ]
+
+
+def check_regressions(by_metric: dict, threshold: float,
+                      baselined: frozenset = frozenset()) -> list[str]:
     """Newest numeric value vs its predecessor, per metric: regressed when
     ``last < (1 - threshold) * prev`` — inverted for the lower-is-better
-    latency suffixes (``last > (1 + threshold) * prev``)."""
+    latency suffixes (``last > (1 + threshold) * prev``).  Metrics in
+    ``baselined`` (committed BENCH_BASELINES.json pins) gate even under
+    the prefix/suffix carve-outs — committing a baseline is the promotion
+    act for a chart-only series."""
     failures = []
     for metric, rows in by_metric.items():
-        if metric.endswith(UNGATED_SUFFIXES) \
-                or metric.startswith(UNGATED_PREFIXES):
+        if metric not in baselined and (
+            metric.endswith(UNGATED_SUFFIXES)
+            or metric.startswith(UNGATED_PREFIXES)
+        ):
             continue
         vals = [r["value"] for r in rows if isinstance(r["value"], (int, float))]
         if len(vals) < 2:
@@ -219,6 +260,8 @@ def main(argv=None) -> int:
             print(f"bench_compare: cannot parse {path}: {e}", file=sys.stderr)
             return 2
     rows.sort(key=lambda r: (r["round"] is None, r["round"]))
+    baseline_rows = load_baselines()
+    rows = baseline_rows + rows
     if args.runs:
         rows.extend(load_runs_jsonl(args.runs))
     rows.extend(compile_s_rows(rows))
@@ -234,7 +277,10 @@ def main(argv=None) -> int:
                 f"{str(r['value']):>12} {str(r['backend']):>8} "
                 f"{str(r.get('rounds')):>8} {str(r.get('wall_s')):>9}"
             )
-    failures = check_regressions(by_metric, args.threshold)
+    failures = check_regressions(
+        by_metric, args.threshold,
+        frozenset(r["metric"] for r in baseline_rows),
+    )
     print()
     if failures:
         for msg in failures:

@@ -174,14 +174,35 @@ fi
 # kregular rung compiled and run end to end (ops/gatherdeliv.py).  The
 # full-scale ladder (10k/100k/1M + the dense-vs-sparse 10k ratio) is
 # `python tools/topo_bench.py` and the committed ARTIFACT_topo_scale.json;
-# topo_* series are chart-only in bench_compare until a baseline exists.
-# TOPO=0 skips (~1 min of small compiles on this box).
+# the ladder/committee topo_* series gate in bench_compare against the
+# committed BENCH_BASELINES.json pins.  TOPO=0 skips (~1 min of small
+# compiles on this box).
 if [ "${TOPO:-1}" != "0" ]; then
     echo "== topo smoke =="
     python tools/topo_bench.py --quick
     topo_rc=$?
     if [ "$topo_rc" -ne 0 ]; then
         echo "lint.sh: topo smoke FAILED (rc=$topo_rc)" >&2
+        rc=1
+    fi
+fi
+
+# Sharded-topology smoke (tools/shard_topo_bench.py --quick): the
+# mesh-sharded overlay pins — sharded kregular/committee bit-equal to the
+# single-device PR 15 programs on a 2-device mesh (uneven n and the
+# mesh-size-1 identity arm included), ONE registry entry across fault
+# counts — plus one sharded rung over the full 8-virtual-device mesh;
+# lands shard_topo_ticks_per_s in runs.jsonl where bench_compare gates it
+# higher-is-better (the full run's shard_topo_full_* series stays
+# chart-only so smoke and full scales never mix).  SHARD_TOPO=0 skips
+# (~1 min of small compiles on this box); the full-scale run is `python
+# tools/shard_topo_bench.py` and the committed ARTIFACT_shard_topo.json.
+if [ "${SHARD_TOPO:-1}" != "0" ]; then
+    echo "== shard topo smoke =="
+    python tools/shard_topo_bench.py --quick
+    shard_topo_rc=$?
+    if [ "$shard_topo_rc" -ne 0 ]; then
+        echo "lint.sh: shard topo smoke FAILED (rc=$shard_topo_rc)" >&2
         rc=1
     fi
 fi

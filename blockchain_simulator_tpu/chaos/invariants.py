@@ -391,3 +391,64 @@ def check_server(
     if registry_before is not None and registry_after is not None:
         violations += registry_monotone(registry_before, registry_after)
     return violations
+
+
+def check_consensus_probes(summaries, max_lag: int | None = None) -> list[str]:
+    """The ISSUE 17 consensus-safety invariant: no in-program monitor
+    fired across a scenario's probed runs.
+
+    ``summaries`` is an iterable of probe summaries (obsim/schema.
+    summarize output — a row's ``m["probe"]``, a serve response's
+    ``metrics["probe"]``, or a bare summary dict).  Each summary's
+    safety counters (``viol_agreement``, ``viol_quorum`` — already
+    host-aggregated into its ``"violations"`` total) must be zero:
+    these are the on-device twins of the host agreement checks, so a
+    nonzero count under a crash/delay drill means the fault injection
+    broke consensus SAFETY, not just liveness — always a violation.
+
+    ``liveness_lag`` (progress-free trailing window, in samples) is a
+    gauge, not a safety counter: it is gated only when the caller sets
+    ``max_lag`` (scenario-specific — a crash drill legitimately stalls
+    progress; a fault-free soak should not).
+
+    Returns human-readable strings, empty when clean — the drill sums
+    them into ``chaos_invariant_violations`` like every other check."""
+    violations: list[str] = []
+    for i, summary in enumerate(summaries):
+        if not isinstance(summary, dict):
+            violations.append(f"probe summary {i} is not a dict: {summary!r}")
+            continue
+        s = summary["probe"] if "probe" in summary else summary
+        who = (f"run {i} ({s.get('protocol', '?')}/"
+               f"{s.get('topology', '?')})")
+        mon = s.get("monitors")
+        if mon is None:
+            violations.append(f"{who}: no monitors in probe summary "
+                              f"(probes disarmed or monitors=False)")
+            continue
+        n_viol = s.get("violations", 0)
+        if n_viol:
+            detail = {k: mon.get(k) for k in ("viol_agreement", "viol_quorum")}
+            violations.append(
+                f"{who}: {n_viol} consensus safety violation(s) {detail}"
+            )
+        if max_lag is not None:
+            lag = mon.get("liveness_lag")
+            lag_max = max(_flat_ints(lag)) if lag is not None else None
+            if lag_max is not None and lag_max > max_lag:
+                violations.append(
+                    f"{who}: liveness lag {lag_max} samples exceeds "
+                    f"max_lag={max_lag}"
+                )
+    return violations
+
+
+def _flat_ints(v):
+    """Flatten a summary leaf (int, or nested lists from committee /
+    multi-lane summaries) to a flat int list."""
+    if isinstance(v, (list, tuple)):
+        out = []
+        for x in v:
+            out.extend(_flat_ints(x))
+        return out or [0]
+    return [int(v)]

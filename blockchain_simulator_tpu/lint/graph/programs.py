@@ -552,4 +552,103 @@ def build_catalog() -> list[ProgramSpec]:
     specs.append(ProgramSpec(
         "trace.mixed_cont", "trace-mixed", build_mx_cont))
 
+    # --- utils/trace._committee_traced_fn ("trace-committee") -----------
+    # The committee --trace arm (ISSUE 17 satellite: the old typed refusal
+    # became a stacked [C, T] probe program).  Taps ride inside the jit, so
+    # the host-callback rule audits it like every consensus program.
+    def build_trace_committee():
+        from blockchain_simulator_tpu.utils import trace
+
+        return (_raw(trace._committee_traced_fn)(cfgs["pbft_comm"]),
+                (_key_sds(),))
+
+    specs.append(ProgramSpec(
+        "trace.committee", "trace-committee", build_trace_committee))
+
+    # --- obsim/build.py factories ("consobs-*") -------------------------
+    # The armed twins of the dyn-fault programs (ISSUE 17): probe taps +
+    # monitors as extra scan outputs.  Audited for the same contracts as
+    # their disarmed twins — no host callback in the HLO (the taps are
+    # traced data, the telemetry hook is host-side in obsim/host.py), no
+    # scatter in the batched bodies — plus divergence twins pinning ONE
+    # executable per (fault structure, probe config): arming probes must
+    # not reintroduce the per-fault-level recompile leak.
+    def _pcfg():
+        from blockchain_simulator_tpu.obsim import schema as obsim_schema
+
+        return obsim_schema.ProbeConfig()
+
+    def consobs_solo_spec(name, arm, fc_kw, group, budget):
+        def build():
+            import dataclasses as _dc
+
+            from blockchain_simulator_tpu.obsim import build as obsim_build
+
+            cfg = cfgs[arm]
+            if fc_kw:
+                cfg = cfg.with_(faults=_dc.replace(cfg.faults, **fc_kw))
+            fn = _raw(obsim_build.probed_solo_fn)(cfg, _pcfg())
+            return fn, (_key_sds(), _i32_sds(), _i32_sds())
+
+        return ProgramSpec(name, "consobs-solo", build,
+                           divergence_group=group, budget=budget)
+
+    specs.append(consobs_solo_spec("consobs.solo_pbft", "pbft_tick",
+                                   {"n_byzantine": 1},
+                                   "consobs-solo:pbft_tick", True))
+    specs.append(consobs_solo_spec("consobs.solo_pbft_b2", "pbft_tick",
+                                   {"n_byzantine": 2},
+                                   "consobs-solo:pbft_tick", False))
+    specs.append(consobs_solo_spec("consobs.solo_comm", "pbft_comm",
+                                   {}, None, True))
+    specs.append(consobs_solo_spec("consobs.solo_raft_hb", "raft_hb",
+                                   {}, None, True))
+    specs.append(consobs_solo_spec("consobs.solo_pbft_round", "pbft_round",
+                                   {}, None, True))
+
+    def consobs_batched_spec(name, fc_kw, multi_seed, group, budget):
+        def build():
+            import dataclasses as _dc
+
+            from blockchain_simulator_tpu.obsim import build as obsim_build
+
+            cfg = cfgs["pbft_tick"]
+            cfg = cfg.with_(faults=_dc.replace(cfg.faults, **fc_kw))
+            fn = _raw(obsim_build.probed_batched_fn)(
+                cfg, _pcfg(), multi_seed=multi_seed
+            )
+            return fn, (_keys_sds(2), _i32_sds((2,)), _i32_sds((2,)))
+
+        return ProgramSpec(name, "consobs-batched", build,
+                           divergence_group=group, budget=budget)
+
+    specs.append(consobs_batched_spec(
+        "consobs.batched_pbft", {"n_byzantine": 1}, False,
+        "consobs-batched:pbft_tick", True))
+    specs.append(consobs_batched_spec(
+        "consobs.batched_pbft_b2", {"n_byzantine": 2}, False,
+        "consobs-batched:pbft_tick", False))
+    # the multi-seed lax.map arm inherits the scatter-free-body contract
+    # of multi-seed-tick (#0i): probes must not smuggle a scatter in
+    specs.append(consobs_batched_spec(
+        "consobs.batched_multi_seed", {"n_byzantine": 1}, True,
+        None, True))
+
+    def consobs_mesh_spec(name, sweep_n, node_n, budget):
+        def build():
+            from blockchain_simulator_tpu.obsim import build as obsim_build
+            from blockchain_simulator_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh(n_node_shards=node_n, n_sweep=sweep_n)
+            fn = _raw(obsim_build.probed_mesh_fn)(
+                cfgs["pbft_tick"], _pcfg(), mesh
+            )
+            b = max(sweep_n, 2)
+            return fn, (_keys_sds(b), _i32_sds((b,)), _i32_sds((b,)))
+
+        return ProgramSpec(name, "consobs-mesh", build, budget=budget)
+
+    specs.append(consobs_mesh_spec("consobs.mesh_sweep", 2, 1, True))
+    specs.append(consobs_mesh_spec("consobs.mesh_nodes", 1, 2, True))
+
     return specs

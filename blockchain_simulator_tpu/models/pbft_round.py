@@ -70,6 +70,8 @@ self-rescheduling loop (pbft-node.cc:372-411); thresholds pbft-node.cc:231,
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from flax import struct
@@ -400,27 +402,33 @@ def scan_rounds(cfg, state, key, with_probe: bool = False):
     ``with_probe=True`` (utils/trace.run_traced) additionally emits the
     standard pbft probe (utils/trace.probe reads the shared field names)
     as scan ``ys`` — one sample per ROUND, the state after that round's
-    whole wave — and returns ``(state, ys)``.  The state trajectory is
+    whole wave — and returns ``(state, ys)``.  A CALLABLE ``with_probe``
+    (obsim/build.py) is used as the probe function ``state -> pytree``
+    instead of the trace one, same contract.  The state trajectory is
     bit-identical either way (the probe only reads)."""
     from blockchain_simulator_tpu.utils import trace as trace_mod
+
+    if with_probe is True:
+        probe_fn = functools.partial(trace_mod.probe, cfg)
+    else:
+        probe_fn = with_probe or None
 
     bt = cfg.pbft_block_interval_ms
     r_last = (cfg.ticks - 1) // bt
     if r_last < 1:
-        if with_probe:
+        if probe_fn is not None:
             empty = jax.tree.map(
-                lambda x: jnp.zeros((0,), x.dtype),
-                trace_mod.probe(cfg, state),
+                lambda x: jnp.zeros((0,), x.dtype), probe_fn(state)
             )
             return state, empty
         return state
 
     def body(st, r):
         st = step_round(cfg, st, r, key)
-        return st, trace_mod.probe(cfg, st) if with_probe else ()
+        return st, probe_fn(st) if probe_fn is not None else ()
 
     state, ys = jax.lax.scan(body, state, jnp.arange(1, r_last + 1))
-    return (state, ys) if with_probe else state
+    return (state, ys) if probe_fn is not None else state
 
 
 def metrics(cfg, state) -> dict:

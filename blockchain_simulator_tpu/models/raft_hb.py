@@ -350,7 +350,7 @@ def materialize(cfg, state, h: Handoff, scan_out, axis=None):
     )
 
 
-def scan_from_init(cfg, state, bufs, key):
+def scan_from_init(cfg, state, bufs, key, probe=None):
     """Fully traced round-schedule raft simulation from an initial
     (state, bufs): tick-engine election prefix, traced checked handoff,
     ``lax.cond`` into either the heartbeat scan or a CONTINUATION of the
@@ -359,29 +359,63 @@ def scan_from_init(cfg, state, bufs, key):
 
     Shared by the single-chip runner (runner.make_sim_fn), vmapped sweeps
     (parallel/sweep.py) and the node-sharded path (parallel/shard.py, which
-    calls it inside ``shard_map`` with ``cfg.mesh_axis`` set)."""
+    calls it inside ``shard_map`` with ``cfg.mesh_axis`` set).
+
+    ``probe`` (obsim/build.py) arms in-program taps without forking the
+    engine: a ``(sample_fn, steady_map_fn, reduce_fn)`` triple —
+    ``sample_fn(state) -> {field: scalar}`` per TICK, ``steady_map_fn(ys,
+    handoff_state) -> {field: [K]}`` lifting the heartbeat scan's ys into
+    the same fields, and ``reduce_fn(series) -> pytree`` collapsing a
+    variable-length sample axis to a FIXED shape, so both ``lax.cond``
+    branches (prefix+heartbeats vs prefix+ticks — different sample
+    counts) merge on identical avals.  Returns ``(final, probes)``; the
+    state trajectory is bit-identical to the unprobed call (taps only
+    read; they consume zero PRNG)."""
     axis = cfg.mesh_axis
     t_e = prefix_ticks(cfg)
+    sample_fn, steady_map_fn, reduce_fn = probe or (None, None, None)
 
     def tick_body(carry, t):
         st, bf = carry
         st, bf = raft_tick.step(cfg, st, bf, t, prng.tick_key(key, t))
-        return (st, bf), ()
+        return (st, bf), sample_fn(st) if sample_fn is not None else ()
+
+    def _cat(pre, post):
+        return jax.tree.map(
+            lambda a, b: jnp.concatenate([a, b], axis=0), pre, post
+        )
 
     # ---- phase 1: election prefix on the tick engine -----------------------
-    carry, _ = jax.lax.scan(tick_body, (state, bufs), jnp.arange(t_e))
+    carry, pre_ys = jax.lax.scan(tick_body, (state, bufs), jnp.arange(t_e))
     ok, h = handoff(cfg, carry[0], axis)
 
+    if probe is None:
+
+        def fast_branch(carry):
+            return materialize(cfg, carry[0], h, steady_scan(cfg, key, h),
+                               axis)
+
+        def tick_branch(carry):
+            # the election prefix did not reach the quiet handoff window:
+            # the faithful tick engine takes over from the prefix carry
+            (st, _), _ = jax.lax.scan(
+                tick_body, carry, t_e + jnp.arange(max(cfg.ticks - t_e, 0))
+            )
+            return st
+
+        return jax.lax.cond(ok, fast_branch, tick_branch, carry)
+
     def fast_branch(carry):
-        return materialize(cfg, carry[0], h, steady_scan(cfg, key, h), axis)
+        out, hb_ys = steady_scan(cfg, key, h, with_probe=True)
+        st = materialize(cfg, carry[0], h, out, axis)
+        series = _cat(pre_ys, steady_map_fn(hb_ys, carry[0]))
+        return st, reduce_fn(series)
 
     def tick_branch(carry):
-        # the election prefix did not reach the quiet handoff window: the
-        # faithful tick engine takes over, continuing the prefix carry
-        (st, _), _ = jax.lax.scan(
+        (st, _), ys = jax.lax.scan(
             tick_body, carry, t_e + jnp.arange(max(cfg.ticks - t_e, 0))
         )
-        return st
+        return st, reduce_fn(_cat(pre_ys, ys))
 
     return jax.lax.cond(ok, fast_branch, tick_branch, carry)
 

@@ -1,0 +1,24 @@
+"""obsim — in-program consensus observability.
+
+Traced probe taps, on-device invariant monitors, and first-divergence
+forensics that ride INSIDE the compiled simulation programs (ISSUE 17).
+The host-side-only telemetry rule (KNOWN_ISSUES #0m) is untouched: every
+value this package produces on-device is ordinary traced data returned
+alongside the final state — never a host callback — and only
+:mod:`obsim.host` (which never enters a trace) may touch
+``utils/telemetry``.
+
+Layout:
+
+- :mod:`obsim.schema` — the probe schema: :class:`~obsim.schema.ProbeConfig`
+  (frozen, hashable — rides executable-registry keys), per-protocol field
+  registry, window-boundary math, host-side summaries.
+- :mod:`obsim.taps` — the traced taps: per-tick samples, windowed
+  reductions, liveness lag, final-state invariant monitors.  Imported from
+  inside jitted programs; telemetry-free by construction (pinned).
+- :mod:`obsim.build` — probed twins of the runner/sweep program factories,
+  cached in the unified executable registry under ``consobs-*`` names.
+- :mod:`obsim.diverge` — first-divergence forensics over two probe series.
+- :mod:`obsim.host` — the host boundary: run probed programs, summarize,
+  trip the flight recorder on monitor violations.
+"""

@@ -1,0 +1,81 @@
+"""The host boundary of obsim: run probed programs, summarize lanes,
+trip the flight recorder on monitor violations.
+
+This is the ONLY obsim module allowed to import ``utils/telemetry`` —
+everything it does happens strictly AFTER ``block_until_ready``, on
+host-side numpy, so the host-side-only telemetry rule (KNOWN_ISSUES
+#0m) holds by layering: taps/build/schema/diverge stay telemetry-free
+(source-pinned in tests/test_zzobsim.py) and no callback can reach a
+trace through here.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from blockchain_simulator_tpu.models import base as base_model
+from blockchain_simulator_tpu.models.base import sim_metrics
+from blockchain_simulator_tpu.obsim import build
+from blockchain_simulator_tpu.obsim import schema
+from blockchain_simulator_tpu.utils import telemetry
+
+
+def summarize_lane(cfg, pcfg: schema.ProbeConfig, probes, lane: int) -> dict:
+    """Summarize ONE lane of a batched probe pytree (leading batch axis
+    from vmap/lax.map/mesh dispatch) — slice, then schema.summarize."""
+    return schema.summarize(
+        cfg, pcfg, jax.tree.map(lambda x: np.asarray(x)[lane], probes)
+    )
+
+
+def note_violations(summary: dict, cfg, seed: int) -> str | None:
+    """The violation → post-mortem hook: when a probe summary carries
+    nonzero safety-monitor counters, record the event on the flight ring
+    and dump a ``consensus-violation`` post-mortem (armed by
+    ``$BLOCKSIM_FLIGHT_DIR``; utils/telemetry.FlightRecorder).  Returns
+    the dump path (None when clean or disarmed).  Liveness lag is a
+    gauge, not a violation — it never trips this hook
+    (chaos/invariants.check_consensus_probes gates it separately)."""
+    if not summary.get("violations"):
+        return None
+    from blockchain_simulator_tpu.utils import obs
+
+    telemetry.flight.note(
+        "consensus-violation",
+        protocol=summary.get("protocol"),
+        topology=summary.get("topology"),
+        seed=int(seed),
+        config=obs.config_hash(cfg),
+        monitors=summary.get("monitors"),
+    )
+    telemetry.metrics.counter("obsim_violations_total").inc(
+        summary["violations"]
+    )
+    return telemetry.flight.dump("consensus-violation")
+
+
+def run_probed(cfg, seed: int = 0, pcfg: schema.ProbeConfig | None = None,
+               n_crashed: int | None = None,
+               n_byzantine: int | None = None) -> tuple[dict, dict]:
+    """Solo probed run: ``(metrics, probe_summary)`` for one (cfg, seed).
+
+    The host-facing entry for drills, the report tool and notebooks: the
+    armed executable comes from the ``consobs-solo`` registry entry (one
+    per (fault structure, probe config)); fault counts default to the
+    config's own (the static-arm convention).  Primary metrics are
+    bit-equal to the disarmed run under the exact sampler — the probe
+    summary is pure addition."""
+    pcfg = pcfg or schema.ProbeConfig()
+    canon = base_model.canonical_fault_cfg(cfg)
+    fc = cfg.faults
+    ops = (fc.resolved_n_crashed(cfg.n) if n_crashed is None else n_crashed,
+           fc.n_byzantine if n_byzantine is None else n_byzantine)
+    sim = build.probed_solo_fn(canon, pcfg)
+    final, probes = jax.block_until_ready(
+        sim(jax.random.PRNGKey(seed), *map(int, ops))
+    )
+    m = sim_metrics(cfg, final)
+    summary = schema.summarize(canon, pcfg, jax.tree.map(np.asarray, probes))
+    note_violations(summary, cfg, seed)
+    return m, summary

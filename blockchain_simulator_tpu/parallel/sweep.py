@@ -420,27 +420,38 @@ def _dyn_operands(cfg: SimConfig, fc) -> tuple[int, int]:
 
 def _dispatch_dyn_points(canon: SimConfig, points, record: bool = True,
                          n_out: int | None = None, mesh=None,
-                         multi_seed: bool = False):
+                         multi_seed: bool = False, probe=None):
     """ONE un-journaled batched dispatch of a same-structure point list —
     the body :func:`run_dyn_points` either calls directly (no journal) or
     wraps in chunked, supervised, durable execution.  ``multi_seed``
     selects the scatter-free ``lax.map`` program (:func:`multi_seed_fn`)
     over the vmapped one on the single-device path; a mesh dispatch
-    already maps sequentially per device, so the flag is a no-op there."""
+    already maps sequentially per device, so the flag is a no-op there.
+    ``probe`` (an obsim/schema.ProbeConfig) swaps in the armed twin of
+    the same arm (obsim/build.py ``consobs-*`` registry entries) and
+    attaches a per-row ``"probe"`` summary; monitor violations trip the
+    flight recorder host-side (obsim/host.note_violations)."""
     points = list(points)
     # the batched-dispatch chaos point: the drills inject raise/hang/slow
     # here — the exact exception path a real backend fault takes through
     # the sweeps AND the serving degrade machinery (chaos/inject.py)
     inject.chaos_point("sweep.dyn_dispatch", canon=canon, n=len(points))
+    if probe is not None:
+        from blockchain_simulator_tpu.obsim import build as obsim_build
     dispatch_points = points
     if mesh is not None and partition.mesh_size(mesh) > 1:
         lanes = max(partition.sweep_axis_size(mesh), 1)
         dispatch_points, _ = partition.pad_points(points, lanes)
-        batched = mesh_dyn_batched_fn(canon, mesh)
+        batched = (obsim_build.probed_mesh_fn(canon, probe, mesh)
+                   if probe is not None else mesh_dyn_batched_fn(canon, mesh))
     elif multi_seed:
-        batched = multi_seed_fn(canon, len(points))
+        batched = (obsim_build.probed_batched_fn(canon, probe,
+                                                 multi_seed=True)
+                   if probe is not None
+                   else multi_seed_fn(canon, len(points)))
     else:
-        batched = dyn_batched_fn(canon)
+        batched = (obsim_build.probed_batched_fn(canon, probe)
+                   if probe is not None else dyn_batched_fn(canon))
     keys = jax.vmap(jax.random.key)(
         jnp.asarray([s for _, s in dispatch_points], jnp.uint32)
     )
@@ -452,13 +463,19 @@ def _dispatch_dyn_points(canon: SimConfig, points, record: bool = True,
     # routed here is already inside its own profile_region — the nested
     # guard skips this one.
     with telemetry.profile_region("sweep_dispatch"):
-        finals = jax.block_until_ready(batched(keys, nc, nb))
+        outs = jax.block_until_ready(batched(keys, nc, nb))
+    finals, probes = outs if probe is not None else (outs, None)
     out = []
     if n_out is not None:
         points = points[:n_out]
     for i, (cfg_i, seed) in enumerate(points):
         final_i = jax.tree.map(lambda x: x[i], finals)
         m = sim_metrics(cfg_i, final_i)
+        if probe is not None:
+            from blockchain_simulator_tpu.obsim import host as obsim_host
+
+            m["probe"] = obsim_host.summarize_lane(cfg_i, probe, probes, i)
+            obsim_host.note_violations(m["probe"], cfg_i, int(seed))
         if record:
             obs.record_run({"seed": int(seed), **m}, cfg_i)
         out.append(m)
@@ -466,7 +483,7 @@ def _dispatch_dyn_points(canon: SimConfig, points, record: bool = True,
 
 
 def _run_chunk(canon, tile, record, n_out, mesh, supervise, journal, key,
-               index, multi_seed=False):
+               index, multi_seed=False, probe=None):
     """Compute ONE chunk, optionally under the supervisor's deadline →
     retry → degrade state machine (parallel/journal.py).  The
     ``sweep.chunk`` chaos point fires once per ATTEMPT with the arm in
@@ -483,7 +500,7 @@ def _run_chunk(canon, tile, record, n_out, mesh, supervise, journal, key,
         with telemetry.span("sweep.chunk", key=key, index=index,
                             n=len(tile), arm="primary"):
             return _dispatch_dyn_points(canon, tile, record, n_out, mesh,
-                                        multi_seed)
+                                        multi_seed, probe)
 
     if supervise is None:
         return primary()
@@ -523,7 +540,7 @@ def _run_chunk(canon, tile, record, n_out, mesh, supervise, journal, key,
             with telemetry.span("sweep.chunk", key=key, index=index,
                                 n=len(tile), arm="degrade"):
                 return _dispatch_dyn_points(canon, tile, record, n_out,
-                                            mesh=None)
+                                            mesh=None, probe=probe)
 
     rows, _events = journal_mod.run_supervised(
         primary, degrade, supervise, journal=journal, key=key,
@@ -534,7 +551,7 @@ def _run_chunk(canon, tile, record, n_out, mesh, supervise, journal, key,
 def run_dyn_points(canon: SimConfig, points, record: bool = True,
                    n_out: int | None = None, mesh=None, journal=None,
                    chunk_size: int | None = None, supervise=None,
-                   multi_seed: bool = False):
+                   multi_seed: bool = False, probe=None):
     """THE group-dispatch primitive: one vmapped executable over an
     arbitrary list of same-structure ``(cfg, seed)`` points.
 
@@ -589,11 +606,21 @@ def run_dyn_points(canon: SimConfig, points, record: bool = True,
     ARTIFACT_tick_bench.json), rows bit-equal under the exact sampler.
     The default stays the vmapped program so existing registry
     trajectories and pins are untouched; ``runner.run_multi_seed`` and
-    the sweeps' ``multi_seed=`` kwarg are the opt-ins."""
+    the sweeps' ``multi_seed=`` kwarg are the opt-ins.
+
+    ``probe=`` (an obsim/schema.ProbeConfig) arms the in-program
+    consensus taps: every row gains a ``"probe"`` summary
+    (obsim/schema.summarize) and monitor violations trip the flight
+    recorder (obsim/host.note_violations).  Primary metrics stay
+    bit-equal to the disarmed dispatch — taps consume zero PRNG.  Armed
+    flushes journal under a probe-suffixed chunk key, so a journal
+    written disarmed never answers an armed flush (and vice versa);
+    journal-cached armed rows serve their stored summaries as-written
+    without re-firing the violation hook."""
     points = list(points)
     if journal is None and supervise is None:
         return _dispatch_dyn_points(canon, points, record, n_out, mesh,
-                                    multi_seed)
+                                    multi_seed, probe)
     if not points:
         return []
     if chunk_size is None or n_out is not None:
@@ -611,6 +638,10 @@ def run_dyn_points(canon: SimConfig, points, record: bool = True,
         want = len(tile) if n_out is None else max(0, min(len(tile), n_out))
         t_out = None if n_out is None else want
         key = journal_mod.chunk_key(canon, index, tile, mesh, n_out=t_out)
+        if probe is not None:
+            # armed and disarmed flushes must never share a journal key:
+            # a cached disarmed chunk has no "probe" summaries to serve
+            key += f"+p{probe.windows}{'m' if probe.monitors else ''}"
         cached = done.get(key)
         if cached is not None and len(cached) == want:
             out.extend(cached)
@@ -619,7 +650,7 @@ def run_dyn_points(canon: SimConfig, points, record: bool = True,
         # arm's rows (journaled below) reach runs.jsonl — an abandoned
         # slow attempt finishing late must not double-record its points
         rows = _run_chunk(canon, tile, False, t_out, mesh, supervise,
-                          journal, key, index, multi_seed)
+                          journal, key, index, multi_seed, probe)
         # durable BEFORE the next chunk dispatches — the recompute-at-
         # most-one contract the kill -9 drill pins
         if journal is not None:

@@ -93,6 +93,26 @@ def _solo_metrics(req):
         inject.chaos_point("serve.solo_dispatch", req_id=req.req_id)
         with telemetry.profile_region("serve_solo"):
             keys, nc, nb = _operands([req])
+            if req.probe is not None:
+                # the armed solo twin (consobs-solo registry entry) —
+                # same operands, final state bit-equal under the exact
+                # sampler; the probe summary rides the metrics row
+                from blockchain_simulator_tpu.obsim import build as obsb
+                from blockchain_simulator_tpu.obsim import host as obsh
+                from blockchain_simulator_tpu.obsim import (
+                    schema as obs_schema,
+                )
+
+                final, probes = jax.block_until_ready(
+                    obsb.probed_solo_fn(req.canon, req.probe)(
+                        keys[0], nc[0], nb[0]
+                    )
+                )
+                m = sim_metrics(req.cfg, final)
+                m["probe"] = obs_schema.summarize(req.canon, req.probe,
+                                                  probes)
+                obsh.note_violations(m["probe"], req.cfg, req.seed)
+                return m
             final = jax.block_until_ready(
                 _solo_fn(req.canon)(keys[0], nc[0], nb[0])
             )
@@ -188,9 +208,12 @@ def run_batch(reqs, max_batch: int, force_solo: bool = False,
         d0 = time.monotonic()
         try:
             with telemetry.profile_region("serve_flush"):
+                # the batcher groups on (canon, probe), so one flush is
+                # probe-homogeneous: reqs[0].probe speaks for every lane
                 rows = sweep.run_dyn_points(
                     canon, [(r.cfg, r.seed) for r in lanes], record=False,
                     n_out=len(reqs), mesh=mesh, journal=journal,
+                    probe=reqs[0].probe,
                 )
         finally:
             d1 = time.monotonic()

@@ -36,7 +36,7 @@ from blockchain_simulator_tpu.models.base import canonical_fault_cfg
 from blockchain_simulator_tpu.utils.config import FaultConfig, SimConfig
 
 # Request-level keys that are not SimConfig fields.
-REQUEST_KEYS = ("id", "seed", "timeout_s")
+REQUEST_KEYS = ("id", "seed", "timeout_s", "probe")
 
 # SimConfig fields a request may set.  mesh_axis is excluded: the serving
 # dispatch is single-device vmap (sharded serving is ROADMAP item 2).
@@ -190,6 +190,12 @@ class ScenarioRequest:
     timeout_s: float
     submitted: float = 0.0
     replayed: bool = False
+    # in-program probe opt-in (obsim/schema.ProbeConfig, None = disarmed):
+    # part of the batch-group key — armed and disarmed requests never share
+    # a dispatched executable, and the armed group's program comes from the
+    # consobs-* registry entries (obsim/build.py), so arming one request
+    # can never change another's program
+    probe: object = None
     # -- telemetry (utils/telemetry.py; host-side only) --------------------
     # trace identity: minted at admission (or adopted from the router's
     # X-Blocksim-Trace header, in which case parent_span is the router's
@@ -230,6 +236,13 @@ def parse_request(obj, req_id: str, default_timeout_s: float = 30.0,
         timeout_s = float(obj.pop("timeout_s", default_timeout_s))
     except (TypeError, ValueError) as e:
         raise InvalidRequestError(f"timeout_s: {e}") from e
+
+    probe_kw = obj.pop("probe", False)
+    if probe_kw is not False and not isinstance(probe_kw, (bool, dict)):
+        raise InvalidRequestError(
+            "probe must be true/false or a JSON object of ProbeConfig "
+            f"fields, got {type(probe_kw).__name__}"
+        )
 
     fault_kw = obj.pop("faults", None)
     if fault_kw is None:
@@ -275,12 +288,29 @@ def parse_request(obj, req_id: str, default_timeout_s: float = 30.0,
     except (NotImplementedError, ValueError, TypeError) as e:
         raise InvalidRequestError(str(e)) from e
 
+    probe = None
+    if probe_kw:
+        from blockchain_simulator_tpu.obsim import build as obsim_build
+        from blockchain_simulator_tpu.obsim import schema as obsim_schema
+
+        try:
+            probe = obsim_schema.ProbeConfig(
+                **(probe_kw if isinstance(probe_kw, dict) else {})
+            )
+            # full admission-time validation (probe schema exists for the
+            # protocol, the armed arm has samples to tap): building the
+            # probed closure is cheap — nothing is traced or compiled here
+            obsim_build.make_probed_dyn_sim_fn(cfg, probe)
+        except (TypeError, ValueError, KeyError) as e:
+            raise InvalidRequestError(f"probe: {e}") from e
+
     return ScenarioRequest(
         req_id=req_id,
         cfg=cfg,
         canon=canonical_fault_cfg(cfg),
         seed=seed,
         timeout_s=timeout_s,
+        probe=probe,
     )
 
 

@@ -558,8 +558,15 @@ class ScenarioServer:
                 else:
                     req, fut = item
                     req.t_drained = time.monotonic()
-                    key = (_QUARANTINE_GROUP, req.req_id) \
-                        if req.req_id in self._quarantine else req.canon
+                    if req.req_id in self._quarantine:
+                        key = (_QUARANTINE_GROUP, req.req_id)
+                    else:
+                        # probe config is part of the group identity:
+                        # armed and disarmed requests never share a flush
+                        # (one executable per (structure, probe config);
+                        # dispatch assumes probe-homogeneous batches)
+                        key = req.canon if req.probe is None \
+                            else (req.canon, req.probe)
                     pending.setdefault(key, []).append((req, fut))
                 try:
                     item = self._arrivals.get_nowait()

@@ -60,7 +60,7 @@ def _committee_keys(key, c: int):
     return jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(c))
 
 
-def stacked_body(cfg, keys, alive_cm, honest_cm):
+def stacked_body(cfg, keys, alive_cm, honest_cm, probe=None):
     """The committee batch body: ``lax.map`` of the unvmapped inner tick
     engine over whatever leading committee axis the inputs carry —
     ``keys [c']``, ``alive_cm/honest_cm [c', m]`` -> stacked final state
@@ -68,9 +68,19 @@ def stacked_body(cfg, keys, alive_cm, honest_cm):
     device) and the mesh arm (parallel/sweep.sharded_topo_sim_fn:
     shard_map hands each device its C/n_shards slice — the body never
     needs to know, there is no cross-committee communication before the
-    host-side outer aggregate in :func:`metrics`)."""
+    host-side outer aggregate in :func:`metrics`).
+
+    ``probe`` (obsim/build.py, utils/trace.py) arms per-committee taps:
+    a ``(sample_fn, finalize_fn)`` pair — ``sample_fn(icfg, state) ->
+    {field: scalar}`` per tick, ``finalize_fn(icfg, final, series) ->
+    pytree`` over the committee's per-tick series ``{field: [T]}``
+    (identity for full traces, windowed reduction + monitors for obsim).
+    ``lax.map`` stacks the per-committee pytrees to leading-``[c', …]``
+    leaves; returns ``(finals, probes)``.  The state trajectory is
+    bit-identical to the unprobed call (taps only read)."""
     proto = base_model.get_protocol(cfg.protocol)
     icfg = inner_cfg(cfg)
+    sample_fn, finalize_fn = probe or (None, None)
 
     def body(args):
         kc, alive_c, honest_c = args
@@ -80,26 +90,33 @@ def stacked_body(cfg, keys, alive_cm, honest_cm):
         def tick(carry, t):
             st, bf = carry
             st, bf = proto.step(icfg, st, bf, t, prng.tick_key(kc, t))
-            return (st, bf), ()
+            return (st, bf), (
+                sample_fn(icfg, st) if sample_fn is not None else ()
+            )
 
-        (state, bufs), _ = jax.lax.scan(
+        (state, bufs), ys = jax.lax.scan(
             tick, (state, bufs), jnp.arange(icfg.ticks)
         )
-        return state
+        if probe is None:
+            return state
+        return state, finalize_fn(icfg, state, ys)
 
     return jax.lax.map(body, (keys, alive_cm, honest_cm))
 
 
-def run_stacked(cfg, key, n_crashed, n_byzantine):
+def run_stacked(cfg, key, n_crashed, n_byzantine, probe=None):
     """Traced committee sim: ``(key, n_crashed, n_byzantine) -> stacked
     final state [C, ...]`` — the dynamic-fault-operand program
     (runner.make_dyn_sim_fn committee arm; the static arm passes the
     config's own counts).  ``cfg`` must already be fault-canonical, like
-    every dyn program (models/base.canonical_fault_cfg)."""
+    every dyn program (models/base.canonical_fault_cfg).  ``probe``
+    threads through to :func:`stacked_body` (returns ``(finals,
+    probes)`` when armed)."""
     c, m = cfg.committees, cfg.n // cfg.committees
     alive, honest = base_model.dyn_fault_masks(cfg.n, n_crashed, n_byzantine)
     keys = _committee_keys(key, c)
-    return stacked_body(cfg, keys, alive.reshape(c, m), honest.reshape(c, m))
+    return stacked_body(cfg, keys, alive.reshape(c, m), honest.reshape(c, m),
+                        probe=probe)
 
 
 def milestone_ms(protocol: str, inner_metrics: dict) -> float:

@@ -70,6 +70,13 @@ SPANS_ENV = "BLOCKSIM_SPANS_JSONL"
 
 # Flight-recorder dump directory; unset = dumps are no-ops.
 FLIGHT_ENV = "BLOCKSIM_FLIGHT_DIR"
+# retention: newest K ARTIFACT_flight_*.json kept per dump directory
+# (default 32; 0 disables pruning) — the flight-dir analog of the
+# obs.append_jsonl size-capped rotation: post-mortems are rolling
+# observability artifacts, and a long chaos drill or a violation storm
+# must not fill the disk with them
+FLIGHT_KEEP_ENV = "BLOCKSIM_FLIGHT_KEEP"
+FLIGHT_KEEP_DEFAULT = 32
 
 # jax.profiler capture directory; unset = profile_region is free.
 PROFILE_ENV = "BLOCKSIM_PROFILE"
@@ -562,9 +569,36 @@ class FlightRecorder:
             except OSError:
                 pass
             return None
+        self._prune(os.path.dirname(path) or ".")
         with self._lock:
             self.dumps += 1
         return path
+
+    @staticmethod
+    def _prune(d: str) -> None:
+        """Keep only the newest ``$BLOCKSIM_FLIGHT_KEEP`` (default 32)
+        ``ARTIFACT_flight_*.json`` post-mortems in ``d``; 0 disables.
+        Runs after every successful dump; failures are swallowed like the
+        dump's own (the recorder never takes down its process)."""
+        try:
+            keep = int(os.environ.get(FLIGHT_KEEP_ENV, FLIGHT_KEEP_DEFAULT))
+        except ValueError:
+            keep = FLIGHT_KEEP_DEFAULT
+        if keep <= 0:
+            return
+        try:
+            names = [n for n in os.listdir(d)
+                     if n.startswith("ARTIFACT_flight_")
+                     and n.endswith(".json")]
+            if len(names) <= keep:
+                return
+            paths = [os.path.join(d, n) for n in names]
+            # (mtime, name): stable order for same-second bursts
+            paths.sort(key=lambda p: (os.path.getmtime(p), p))
+            for p in paths[:-keep]:
+                os.unlink(p)
+        except OSError:
+            pass
 
 
 flight = FlightRecorder()

@@ -319,12 +319,59 @@ def _traced_mixed_fast(cfg: SimConfig, seed):
     return mixed.metrics(cfg, state), series
 
 
+@aotcache.cached_factory("trace-committee")
+def _committee_traced_fn(cfg: SimConfig):
+    """Jitted ``sim(key) -> (stacked_finals, series)`` for the committee
+    hierarchy: the static-arm run_stacked body (runner.make_sim_fn
+    committee arm — config's own fault counts on the dyn operand slots)
+    with the standard probe sampled per tick INSIDE each committee's
+    ``lax.map`` body (topo/committee.stacked_body probe hook), so the
+    series leaves stack to ``[C, ticks]``."""
+    from blockchain_simulator_tpu.models import base as base_model
+    from blockchain_simulator_tpu.topo import committee
+
+    canon = base_model.canonical_fault_cfg(cfg)
+    nc = cfg.faults.resolved_n_crashed(cfg.n)
+    nb = cfg.faults.n_byzantine
+
+    def finalize_fn(icfg, final, ys):
+        del icfg, final  # full per-tick series — no reduction on this path
+        return ys
+
+    @jax.jit
+    def sim(key):
+        return committee.run_stacked(
+            canon, key, jnp.int32(nc), jnp.int32(nb),
+            probe=(probe, finalize_fn),
+        )
+
+    return sim
+
+
+def _traced_committee(cfg: SimConfig, seed):
+    """Committee hierarchy with stacked per-committee probe series.
+
+    ``series`` leaves are ``[C, ticks]`` (lane 0 of the leading axis is
+    committee 0); ``series["t"]`` is the inner tick axis.  Metrics are
+    the committee outer aggregate (topo/committee.metrics), bit-identical
+    to ``run_simulation``'s on this config (probes only read)."""
+    from blockchain_simulator_tpu.topo import committee
+
+    key = jax.random.key(cfg.seed if seed is None else seed)
+    finals, ys = jax.block_until_ready(_committee_traced_fn(cfg)(key))
+    series = _np_series(ys)
+    series["t"] = np.arange(committee.inner_cfg(cfg).ticks)
+    return committee.metrics(cfg, finals), series
+
+
 def _reject_stacked(cfg: SimConfig) -> None:
+    # profile_run only: the profiler capture wraps the flat static
+    # program; probe tracing handles committee via _traced_committee
     if cfg.topology == "committee":
         raise NotImplementedError(
-            "probe tracing steps the flat (state, bufs) engine; the "
-            "committee path's stacked lax.map body has no probe series "
-            "(topo/committee.py) — trace the inner committee config instead"
+            "profile_run wraps the flat (state, bufs) engine; profile the "
+            "inner committee config instead (probe tracing — run_traced — "
+            "does support committee, with stacked [C, ticks] series)"
         )
 
 
@@ -340,17 +387,23 @@ def run_traced(cfg: SimConfig, seed: int | None = None):
 
     - tick engine: per-tick samples, length ``cfg.ticks`` (no ``"t"`` key;
       the sample index IS the tick).  ``cfg.with_(schedule="tick")`` forces
-      this arm for bit-exact tick series on any config.
+      this arm for bit-exact tick series on any config.  The kregular
+      overlay rides this arm too (its tables are trace constants).
     - fast paths: per-round / per-heartbeat samples with a ``"t"`` array of
       virtual ticks (see the module docstring for each protocol's keys).
+    - committee hierarchy: stacked ``[C, ticks]`` series, one lane per
+      committee, plus the inner ``"t"`` tick axis (per-committee counter
+      tracks and instant events in the chrome-trace export).
     """
     from blockchain_simulator_tpu.runner import (
         _reject_cpp_only,
         use_round_schedule,
     )
 
-    _reject_stacked(cfg)
     _reject_cpp_only(cfg)
+    if cfg.topology == "committee":
+        use_round_schedule(cfg)  # validates schedule='round' (always tick)
+        return _traced_committee(cfg, seed)
     if use_round_schedule(cfg):  # raises on ineligible explicit 'round'
         if cfg.protocol == "pbft":
             return _traced_pbft_round(cfg, seed)
@@ -389,12 +442,9 @@ def chrome_events(series: dict, name: str = "sim", pid: int = 0,
          "args": {"name": name}},
     ]
     tid = 0
-    for k in sorted(series):
-        if k == "t":
-            continue
-        v = np.asarray(series[k])
-        if v.ndim != 1 or v.size == 0:
-            continue
+
+    def emit(label: str, v: np.ndarray) -> None:
+        nonlocal tid
         t_axis = (
             ts_map
             if ts_map is not None and len(ts_map) == len(v)
@@ -403,23 +453,37 @@ def chrome_events(series: dict, name: str = "sim", pid: int = 0,
         tid += 1
         events.append({
             "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
-            "args": {"name": k},
+            "args": {"name": label},
         })
         stride = max(1, len(v) // MAX_COUNTER_SAMPLES)
         for i in range(0, len(v), stride):
             events.append({
-                "name": k, "ph": "C", "pid": pid, "tid": 0,
+                "name": label, "ph": "C", "pid": pid, "tid": 0,
                 "ts": int(t_axis[i]) * 1000,
-                "args": {k: float(v[i])},
+                "args": {label: float(v[i])},
             })
         d = np.diff(v.astype(np.int64), prepend=0)
         if np.all(d >= 0):  # monotone counter: increments are events
             for i in np.flatnonzero(d > 0):
                 events.append({
-                    "name": k, "ph": "i", "s": "t", "pid": pid, "tid": tid,
-                    "ts": int(t_axis[i]) * 1000,
+                    "name": label, "ph": "i", "s": "t", "pid": pid,
+                    "tid": tid, "ts": int(t_axis[i]) * 1000,
                     "args": {"value": int(v[i]), "delta": int(d[i])},
                 })
+
+    for k in sorted(series):
+        if k == "t":
+            continue
+        v = np.asarray(series[k])
+        if v.size == 0 or v.ndim not in (1, 2):
+            continue
+        if v.ndim == 1:
+            emit(k, v)
+        else:
+            # stacked committee series [C, m] (run_traced committee arm):
+            # one counter track + per-committee instant events per lane
+            for ci in range(v.shape[0]):
+                emit(f"{k}/c{ci}", v[ci])
     return events
 
 

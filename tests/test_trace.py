@@ -225,3 +225,53 @@ def test_profile_run(tmp_path):
     m = profile_run(CFG.with_(sim_ms=600), str(tmp_path))
     assert m["profiled_run_s"] > 0
     assert any(tmp_path.iterdir())  # a capture landed
+
+
+def test_kregular_trace_regression():
+    """The kregular overlay rides the tick arm (tables are trace
+    constants): per-tick series, metrics identical to the untraced run."""
+    cfg = SimConfig(protocol="pbft", n=12, sim_ms=400, topology="kregular",
+                    degree=10, fidelity="clean")
+    m_t, series = run_traced(cfg)
+    assert m_t == run_simulation(cfg)
+    assert "t" not in series  # tick arm: the sample index IS the tick
+    assert all(v.shape == (cfg.ticks,) for v in series.values())
+
+
+def test_committee_trace_stacked_series(tmp_path):
+    """ISSUE 17 satellite: --trace no longer refuses committee — stacked
+    [C, ticks] series, one lane per committee, metrics bit-identical to
+    the untraced outer aggregate, per-committee chrome-trace tracks."""
+    cfg = SimConfig(protocol="pbft", n=8, sim_ms=400, topology="committee",
+                    committees=2)
+    m_t, series = run_traced(cfg)
+    assert m_t == run_simulation(cfg)
+    inner_ticks = series["t"].shape[0]
+    for k, v in series.items():
+        if k == "t":
+            continue
+        assert v.shape == (2, inner_ticks), k
+    # chrome export: one counter track per (field, committee) lane
+    out = to_chrome_trace(series, tmp_path / "comm.json", name="pbft-comm")
+    doc = json.loads((tmp_path / "comm.json").read_text())
+    lanes = {e["args"]["name"] for e in doc["traceEvents"]
+             if e["name"] == "thread_name"}
+    assert any(name.endswith("/c0") for name in lanes)
+    assert any(name.endswith("/c1") for name in lanes)
+    # per-committee commit instants exist (committee 0 finalizes blocks)
+    assert out["instants"] > 0
+
+
+def test_cli_trace_committee(tmp_path):
+    """The CLI --trace path on a committee config writes the stacked npz
+    (the round-18 refusal is gone)."""
+    from blockchain_simulator_tpu.cli import main
+
+    out = tmp_path / "comm.npz"
+    rc = main(["--protocol", "pbft", "--n", "8", "--sim-ms", "300",
+               "--topology", "committee", "--committees", "2",
+               "--trace", str(out)])
+    assert rc == 0
+    data = np.load(out)
+    stacked = [k for k in data.files if k != "t" and data[k].ndim == 2]
+    assert stacked and all(data[k].shape[0] == 2 for k in stacked)

@@ -1,0 +1,366 @@
+"""obsim/ consensus observability (ISSUE 17): armed-vs-disarmed
+bit-equality, registry discipline, monitors, forensics, and the
+host-side-only layering.
+
+The load-bearing contracts:
+
+- **Bit-equality**: taps read state and consume zero PRNG, so an armed
+  program's state trajectory — and therefore its primary metrics under
+  the exact sampler — is BIT-identical to the disarmed program's.
+- **Registry discipline**: probed programs live under their own
+  ``consobs-*`` names keyed (structure, probe config); fault COUNTS
+  never mint a second executable, and building armed programs leaves
+  the disarmed programs' lowerings byte-identical.
+- **Monitors fire on real forgeries**: a quorum granted to a slot no
+  leader proposed (the byzantine forge) trips the traced agreement
+  monitor, and the host hook dumps a flight post-mortem.
+- **Layering**: obsim's traced modules never import utils/telemetry —
+  the host boundary is obsim/host.py alone.
+"""
+
+import glob
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from blockchain_simulator_tpu.models import base as base_model
+from blockchain_simulator_tpu.models.base import sim_metrics
+from blockchain_simulator_tpu.obsim import build, diverge, host, schema, taps
+from blockchain_simulator_tpu.runner import make_dyn_sim_fn
+from blockchain_simulator_tpu.utils import aotcache, telemetry
+from blockchain_simulator_tpu.utils.config import FaultConfig, SimConfig
+
+
+def _ops(cfg):
+    fc = cfg.faults
+    return int(fc.resolved_n_crashed(cfg.n)), int(fc.n_byzantine)
+
+
+def _pair(cfg, seed=0, pcfg=None):
+    """(disarmed metrics, armed metrics, probe summary) for one config."""
+    canon = base_model.canonical_fault_cfg(cfg)
+    nc, nb = _ops(cfg)
+    key = jax.random.PRNGKey(seed)
+    final_d = jax.block_until_ready(
+        jax.jit(make_dyn_sim_fn(canon))(key, nc, nb)
+    )
+    pcfg = pcfg or schema.ProbeConfig()
+    final_a, probes = jax.block_until_ready(
+        build.probed_solo_fn(canon, pcfg)(key, nc, nb)
+    )
+    return (sim_metrics(cfg, final_d), sim_metrics(cfg, final_a),
+            schema.summarize(canon, pcfg, probes))
+
+
+def _combo(protocol, topology):
+    kw = dict(protocol=protocol, n=8, sim_ms=200, stat_sampler="exact")
+    if topology == "kregular":
+        kw.update(topology="kregular", degree=3, fidelity="clean")
+    elif topology == "committee":
+        kw.update(topology="committee", committees=2)
+    return SimConfig(**kw)
+
+
+# ------------------------------------------------- armed == disarmed ---
+
+# tier-1 covers one combo per protocol on DIFFERENT topologies (the
+# latin square keeps every protocol and every topology under the fast
+# marker); the slow sweep below closes the full 3x3.
+FAST_COMBOS = [("pbft", "full"), ("raft", "kregular"),
+               ("paxos", "committee")]
+SLOW_COMBOS = [(p, t) for p in ("pbft", "raft", "paxos")
+               for t in ("full", "kregular", "committee")
+               if (p, t) not in FAST_COMBOS]
+
+
+@pytest.mark.parametrize("protocol,topology", FAST_COMBOS)
+def test_armed_bit_equal_and_schema(protocol, topology):
+    m_d, m_a, summary = _pair(_combo(protocol, topology), seed=3)
+    assert m_a == m_d  # dict equality over exact-sampler ints: bitwise
+    assert summary["fields"] == sorted(schema.SERIES_FIELDS[protocol])
+    assert summary["violations"] == 0
+    assert summary["windows"] >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("protocol,topology", SLOW_COMBOS)
+def test_armed_bit_equal_full_grid(protocol, topology):
+    m_d, m_a, summary = _pair(_combo(protocol, topology), seed=3)
+    assert m_a == m_d
+    assert summary["fields"] == sorted(schema.SERIES_FIELDS[protocol])
+    assert summary["violations"] == 0
+
+
+def test_armed_bit_equal_pbft_round_fast_path():
+    """The pbft_round fast path threads taps through the round scan;
+    bit-equality must survive the collapsed schedule."""
+    cfg = SimConfig(protocol="pbft", n=8, sim_ms=200, delivery="stat",
+                    schedule="round", model_serialization=False,
+                    stat_sampler="exact")
+    m_d, m_a, summary = _pair(cfg, seed=5)
+    assert m_a == m_d
+    assert summary["violations"] == 0
+
+
+@pytest.mark.slow
+def test_armed_bit_equal_raft_hb_fast_path():
+    """raft_hb's lax.cond prefix/steady/continuation phase split is the
+    hairiest tap threading — slow-marked: its armed+disarmed compiles
+    dominate this file's wall under the tier-1 budget."""
+    cfg = SimConfig(protocol="raft", n=8, sim_ms=400, delivery="stat",
+                    schedule="round", stat_sampler="exact")
+    m_d, m_a, summary = _pair(cfg, seed=5)
+    assert m_a == m_d
+    assert summary["violations"] == 0
+
+
+def test_armed_vmap_bit_equal_threefry_edges():
+    """The batched (vmap) armed arm under the threefry edge sampler: the
+    vmap-stable edge stream (test_ops edge-sampler contract) plus probes
+    must still reproduce the disarmed vmapped lanes bitwise."""
+    cfg = SimConfig(protocol="pbft", n=8, sim_ms=200, stat_sampler="exact",
+                    edge_sampler="threefry",
+                    faults=FaultConfig(n_byzantine=1))
+    canon = base_model.canonical_fault_cfg(cfg)
+    keys = jax.vmap(jax.random.PRNGKey)(np.arange(3, dtype=np.uint32))
+    nc = np.zeros(3, np.int32)
+    nb = np.arange(3, dtype=np.int32) % 2
+    disarmed = jax.jit(jax.vmap(make_dyn_sim_fn(canon)))
+    finals_d = jax.block_until_ready(disarmed(keys, nc, nb))
+    pcfg = schema.ProbeConfig()
+    finals_a, probes = jax.block_until_ready(
+        build.probed_batched_fn(canon, pcfg)(keys, nc, nb)
+    )
+    for lane in range(3):
+        m_d = sim_metrics(cfg, jax.tree.map(lambda x: x[lane], finals_d))
+        m_a = sim_metrics(cfg, jax.tree.map(lambda x: x[lane], finals_a))
+        assert m_a == m_d, lane
+        assert host.summarize_lane(canon, pcfg, probes, lane)[
+            "violations"] == 0
+
+
+def test_multi_seed_map_arm_matches_vmap_arm():
+    """The scatter-free lax.map multi-seed arm returns the same finals
+    AND the same probe pytree as the vmapped arm (both armed)."""
+    cfg = base_model.canonical_fault_cfg(
+        SimConfig(protocol="pbft", n=8, sim_ms=200, stat_sampler="exact")
+    )
+    pcfg = schema.ProbeConfig(windows=4)
+    keys = jax.vmap(jax.random.PRNGKey)(np.arange(2, dtype=np.uint32))
+    nc = nb = np.zeros(2, np.int32)
+    f_v, p_v = jax.block_until_ready(
+        build.probed_batched_fn(cfg, pcfg)(keys, nc, nb))
+    f_m, p_m = jax.block_until_ready(
+        build.probed_batched_fn(cfg, pcfg, multi_seed=True)(keys, nc, nb))
+    for a, b in zip(jax.tree.leaves((f_v, p_v)), jax.tree.leaves((f_m, p_m))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# --------------------------------------------------- registry discipline ---
+
+
+def test_one_executable_per_probe_structure():
+    """Fault counts are traced operands of the armed program too: a
+    probed sweep over 3 fault levels mints ONE consobs executable, and a
+    different probe config mints exactly one more."""
+    from blockchain_simulator_tpu.parallel import sweep
+
+    cfg = SimConfig(protocol="pbft", n=8, sim_ms=1070,  # unique: cold key
+                    stat_sampler="exact")
+    canon = base_model.canonical_fault_cfg(cfg)
+    points = [(cfg.with_(faults=FaultConfig(n_byzantine=b)), 0)
+              for b in (0, 1, 2)]
+    pcfg = schema.ProbeConfig()
+    s0 = aotcache.registry.stats()
+    rows = sweep.run_dyn_points(canon, points, record=False, probe=pcfg)
+    s1 = aotcache.registry.stats()
+    assert s1["misses"] - s0["misses"] == 1
+    assert all("probe" in m for m in rows)
+    # same structure, same probe config: pure hit
+    sweep.run_dyn_points(canon, points, record=False, probe=pcfg)
+    s2 = aotcache.registry.stats()
+    assert s2["misses"] == s1["misses"] and s2["hits"] == s1["hits"] + 1
+    # a DIFFERENT probe structure is a different program: one new miss
+    sweep.run_dyn_points(canon, points, record=False,
+                         probe=schema.ProbeConfig(windows=4))
+    s3 = aotcache.registry.stats()
+    assert s3["misses"] == s2["misses"] + 1
+
+
+def test_disarmed_lowering_untouched_by_arming():
+    """Building armed programs must leave the disarmed program's lowering
+    byte-identical — today's programs do not change when obsim exists."""
+    cfg = base_model.canonical_fault_cfg(
+        SimConfig(protocol="pbft", n=8, sim_ms=210, stat_sampler="exact")
+    )
+    args = (jax.random.PRNGKey(0), 0, 0)
+    before = jax.jit(make_dyn_sim_fn(cfg)).lower(*args).as_text()
+    jax.block_until_ready(
+        build.probed_solo_fn(cfg, schema.ProbeConfig())(*args)
+    )
+    after = jax.jit(make_dyn_sim_fn(cfg)).lower(*args).as_text()
+    assert before == after
+
+
+# ------------------------------------------------------------- monitors ---
+
+
+def test_agreement_monitor_fires_on_byzantine_forge(tmp_path, monkeypatch):
+    """The byzantine forge: grant a full quorum to a slot whose proposal
+    never happened.  The traced agreement monitor (the in-program twin of
+    pbft.metrics forged_commits) must count it, and the host hook must
+    dump a consensus-violation flight post-mortem."""
+    cfg = SimConfig(protocol="pbft", n=8, sim_ms=200, stat_sampler="exact")
+    canon = base_model.canonical_fault_cfg(cfg)
+    final = jax.block_until_ready(
+        jax.jit(make_dyn_sim_fn(canon))(jax.random.PRNGKey(7), 0, 0)
+    )
+    assert int(taps.monitors(canon, final)["viol_agreement"]) == 0
+    propose = np.asarray(final.slot_propose_tick)
+    never = np.flatnonzero(propose == np.iinfo(np.int32).max)
+    assert never.size  # 200 ms leaves unproposed tail slots
+    commits = np.asarray(final.slot_commits).copy()
+    commits[int(never[-1])] = cfg.n
+    forged = final.replace(slot_commits=commits)
+    mon = {k: int(v) for k, v in taps.monitors(canon, forged).items()}
+    assert mon["viol_agreement"] >= 1
+
+    monkeypatch.setenv(telemetry.FLIGHT_ENV, str(tmp_path))
+    summary = {"protocol": "pbft", "topology": "full",
+               "monitors": {**mon, "liveness_lag": 0},
+               "violations": mon["viol_agreement"] + mon["viol_quorum"]}
+    dump = host.note_violations(summary, cfg, seed=7)
+    assert dump and os.path.exists(dump)
+    from blockchain_simulator_tpu.chaos import invariants
+
+    assert invariants.check_consensus_probes([summary])
+
+
+def test_check_consensus_probes_contract():
+    from blockchain_simulator_tpu.chaos import invariants
+
+    clean = {"protocol": "raft", "topology": "full",
+             "monitors": {"viol_agreement": 0, "viol_quorum": 0,
+                          "liveness_lag": 4}, "violations": 0}
+    assert invariants.check_consensus_probes([clean]) == []
+    # lag is a gauge: only gated when the scenario asks
+    assert invariants.check_consensus_probes([clean], max_lag=3)
+    assert invariants.check_consensus_probes([clean], max_lag=4) == []
+    # committee summaries carry per-lane lists
+    comm = {**clean, "monitors": {"viol_agreement": [0, 0],
+                                  "viol_quorum": [0, 0],
+                                  "liveness_lag": [1, 9]}}
+    assert invariants.check_consensus_probes([comm], max_lag=8)
+    # a wrapped metrics row (m["probe"]) is unwrapped
+    assert invariants.check_consensus_probes(
+        [{"n": 8, "probe": clean}]) == []
+    # disarmed rows are themselves a violation of a probed drill
+    assert invariants.check_consensus_probes([{"protocol": "pbft"}])
+
+
+def test_liveness_lag_semantics():
+    prog = np.array([0, 1, 1, 1, 2, 2, 2, 2], np.int32)
+    assert int(taps.liveness_lag(prog)) == 3  # last advance at sample 4
+    assert int(taps.liveness_lag(np.zeros(6, np.int32))) == 6  # never
+    assert int(taps.liveness_lag(np.arange(5, dtype=np.int32) + 1)) == 0
+
+
+# ------------------------------------------------------------ forensics ---
+
+
+def test_first_divergence_locates_planted_perturbation():
+    cfg = base_model.canonical_fault_cfg(
+        SimConfig(protocol="pbft", n=8, sim_ms=200, stat_sampler="exact")
+    )
+    pcfg = schema.ProbeConfig(windows=8)
+    sim = build.probed_solo_fn(cfg, pcfg)
+    _, pa = jax.block_until_ready(sim(jax.random.PRNGKey(11), 0, 0))
+    _, pb = jax.block_until_ready(sim(jax.random.PRNGKey(11), 0, 0))
+    assert diverge.first_divergence(pa, pb) is None
+    series = {k: np.asarray(v).copy() for k, v in pb["series"].items()}
+    series["msgs_rounds"][5] += 1
+    div = diverge.first_divergence(pa, {"series": series})
+    assert div["sample"] == 5 and div["fields"] == ["msgs_rounds"]
+    bounds = schema.window_bounds(cfg.ticks, pcfg.windows)
+    out = diverge.render(div, t_axis=bounds, unit="window")
+    assert "window 5" in out and "msgs_rounds" in out
+    with pytest.raises(ValueError):
+        diverge.first_divergence(pa, {"series": {"nope": series[
+            "msgs_rounds"]}})
+
+
+# ------------------------------------------------- layering + retention ---
+
+
+def test_obsim_traced_modules_are_telemetry_free():
+    """The host-side-only rule, obsim edition: everything that runs under
+    jit (taps/build) plus the pure helpers (schema/diverge) must never
+    reference utils/telemetry — obsim/host.py is the only host boundary
+    (the test_zztelemetry source pin, one layer up)."""
+    import blockchain_simulator_tpu.obsim as obsim_pkg
+
+    pkg = os.path.dirname(obsim_pkg.__file__)
+    for fname in ("taps.py", "build.py", "schema.py", "diverge.py",
+                  "__init__.py"):
+        src = open(os.path.join(pkg, fname)).read()
+        # pin the IMPORT forms, not the bare word: docstrings may name
+        # the rule ("telemetry-free"), code may not reach the module
+        for form in ("import telemetry", "utils.telemetry"):
+            assert form not in src, (fname, form)
+    # and host.py IS allowed — the boundary exists
+    assert "import telemetry" in open(
+        os.path.join(pkg, "host.py")).read()
+
+
+def test_flight_retention(tmp_path, monkeypatch):
+    monkeypatch.setenv(telemetry.FLIGHT_ENV, str(tmp_path))
+    monkeypatch.setenv(telemetry.FLIGHT_KEEP_ENV, "5")
+    fr = telemetry.FlightRecorder(capacity=8)
+    fr.note("x")
+    paths = [fr.dump("ret") for _ in range(9)]
+    assert all(paths)
+    left = glob.glob(str(tmp_path / "ARTIFACT_flight_*.json"))
+    assert len(left) == 5
+    assert paths[-1] in left and paths[0] not in left
+    monkeypatch.setenv(telemetry.FLIGHT_KEEP_ENV, "0")  # disables pruning
+    for _ in range(4):
+        fr.dump("ret")
+    assert len(glob.glob(str(tmp_path / "ARTIFACT_flight_*.json"))) == 9
+
+
+# ----------------------------------------------------------- serve layer ---
+
+
+def test_serve_probe_request_parsing():
+    from blockchain_simulator_tpu.serve import schema as sschema
+
+    obj = {"protocol": "pbft", "n": 8, "sim_ms": 200,
+           "stat_sampler": "exact", "probe": {"windows": 4}}
+    req = sschema.parse_request(dict(obj), "p1")
+    assert req.probe == schema.ProbeConfig(windows=4)
+    assert sschema.parse_request(
+        {**obj, "probe": False}, "p2").probe is None
+    assert sschema.parse_request(
+        {**obj, "probe": True}, "p3").probe == schema.ProbeConfig()
+    for bad in ({"windows": 0}, 7, {"nope": 1}):
+        with pytest.raises(sschema.InvalidRequestError):
+            sschema.parse_request({**obj, "probe": bad}, "bad")
+
+
+def test_serve_solo_probed_dispatch():
+    from blockchain_simulator_tpu.serve import dispatch
+    from blockchain_simulator_tpu.serve import schema as sschema
+
+    obj = {"protocol": "pbft", "n": 8, "sim_ms": 200,
+           "stat_sampler": "exact", "seed": 9}
+    armed = sschema.parse_request({**obj, "probe": True}, "a")
+    plain = sschema.parse_request(dict(obj), "d")
+    (ra, resp_a), = dispatch.run_batch([armed], max_batch=4)
+    (rd, resp_d), = dispatch.run_batch([plain], max_batch=4)
+    assert resp_a["code"] == resp_d["code"] == 200
+    probe = resp_a["metrics"].pop("probe")
+    assert probe["violations"] == 0 and probe["fields"]
+    assert resp_a["metrics"] == resp_d["metrics"]  # bit-equal primaries
+    assert "probe" not in resp_d["metrics"]

@@ -225,6 +225,24 @@ if [ "${TELEM:-1}" != "0" ]; then
     fi
 fi
 
+# Consensus observability report (tools/consensus_obs_report.py --quick):
+# every protocol x topology combo armed-vs-disarmed (primary metrics must
+# stay bit-equal under the exact sampler), monitors clean, the synthetic
+# byzantine forge must fire, forensics must localize, and the armed
+# overhead must stay <= 5% on the tick path + serve flush; lands
+# consobs_overhead_pct / consobs_invariant_violations in runs.jsonl
+# (charted, never gated by bench_compare — the report's own exit code is
+# the gate).  CONSOBS=0 skips; the full run writes ARTIFACT_consobs.json.
+if [ "${CONSOBS:-1}" != "0" ]; then
+    echo "== consensus obs report =="
+    python tools/consensus_obs_report.py --quick
+    consobs_rc=$?
+    if [ "$consobs_rc" -ne 0 ]; then
+        echo "lint.sh: consensus obs report FAILED (rc=$consobs_rc)" >&2
+        rc=1
+    fi
+fi
+
 echo "== bench_compare =="
 if [ -n "${BLOCKSIM_RUNS_JSONL:-}" ] && [ -f "${BLOCKSIM_RUNS_JSONL}" ]; then
     python tools/bench_compare.py --runs "${BLOCKSIM_RUNS_JSONL}" "$@"

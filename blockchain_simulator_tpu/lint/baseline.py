@@ -1,0 +1,116 @@
+"""Shared baseline mechanics for the IR-level audit gates.
+
+jaxgraph (lint/graph, GRAPH_BASELINE.json) and shardlint (lint/comms,
+COMMS_BASELINE.json) grandfather findings the same way jaxlint does —
+committed entries keyed on stable identities with per-entry justifications,
+``--write-baseline`` regeneration that preserves them, ``--prune-baseline``
+hygiene — but on (rule, program, detail) keys instead of source lines, and
+with a ``budgets`` section jaxlint has no analog for.  The count semantics,
+justification preservation and prune bookkeeping live here ONCE so the two
+audits cannot drift: an entry absorbs findings up to its count, a finding
+whose count grew past the entry's stays new, pruning shrinks entries to
+what the current audit still produces and never touches justifications.
+
+Findings are duck-typed: anything exposing ``key() -> (rule, program,
+detail)`` and a ``count`` int works (lint/graph/audit.GraphFinding,
+lint/comms/audit.CommsFinding).
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+
+
+def load_entries(doc: dict) -> dict:
+    """The ``entries`` list of a baseline document as
+    ``{(rule, program, detail): {"count", "justification"}}``."""
+    entries = {}
+    for e in doc.get("entries", []):
+        entries[(e["rule"], e["program"], e["detail"])] = {
+            "count": int(e.get("count", 1)),
+            "justification": e.get("justification", ""),
+        }
+    return entries
+
+
+def split_by_baseline(findings, entries: dict) -> tuple[list, int, list]:
+    """(new findings, n_baselined, stale entry keys) — count semantics match
+    lint/engine.py: an entry absorbs findings up to its count; a finding
+    whose count GREW past the entry's stays new (a program gaining scatters
+    — or collectives — is a change, not grandfather)."""
+    used: Counter = Counter()
+    new = []
+    n_baselined = 0
+    for f in findings:
+        key = f.key()
+        allowed = entries.get(key, {}).get("count", 0)
+        if f.count <= allowed - used[key]:
+            used[key] += f.count
+            n_baselined += 1
+        else:
+            new.append(f)
+    stale = [k for k, e in entries.items() if used[k] < e["count"]]
+    return new, n_baselined, stale
+
+
+def collapse_counts(findings, skip_rules=()) -> Counter:
+    """Findings -> {key: summed count}.  Findings with one identical (rule,
+    program, detail) key must collapse into ONE entry with summed count —
+    the loaded baseline keys a dict, and a written baseline that fails its
+    own next run would be useless.  ``skip_rules`` excludes the
+    baseline-derived rules (budget-missing/-regression): those are
+    represented by the refreshed budgets, not entries."""
+    counts: Counter = Counter()
+    for f in findings:
+        if f.rule in skip_rules:
+            continue
+        counts[f.key()] += f.count
+    return counts
+
+
+def merge_entries(counts: Counter, old_entries: dict) -> list[dict]:
+    """Entry records for ``counts``, preserving old justifications (the
+    lint/engine.py write contract — a rewrite must never lose hand-written
+    justifications)."""
+    entries = []
+    for key, count in sorted(counts.items()):
+        rule, program, detail = key
+        just = old_entries.get(key, {}).get(
+            "justification", "TODO: justify or fix"
+        )
+        entries.append({
+            "rule": rule, "program": program, "detail": detail,
+            "count": count, "justification": just,
+        })
+    return entries
+
+
+def prune_entries(old_entries: dict, consumed: Counter):
+    """Shrink ``old_entries`` to what ``consumed`` (the current audit's
+    collapsed finding counts) still justifies.  Returns ``(entries,
+    dropped_keys, shrunk_keys)``: fixed entries drop entirely, overcounted
+    entries shrink to the consumed count, justifications pass through
+    untouched — pruning never re-pins."""
+    dropped, shrunk, entries = [], [], []
+    for key, entry in sorted(old_entries.items()):
+        rule, program, detail = key
+        live = min(entry["count"], consumed.get(key, 0))
+        if live == 0:
+            dropped.append(key)
+            continue
+        if live < entry["count"]:
+            shrunk.append(key)
+        entries.append({
+            "rule": rule, "program": program, "detail": detail,
+            "count": live, "justification": entry.get("justification", ""),
+        })
+    return entries, dropped, shrunk
+
+
+def dump_doc(path: str, doc: dict) -> None:
+    """The one serialization both baseline files share (indent=1 + trailing
+    newline, the committed-diff-friendly format)."""
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=False)
+        f.write("\n")

@@ -1,0 +1,13 @@
+"""shardlint: the post-SPMD communication auditor.
+
+jaxlint stops at the AST and jaxgraph traces jaxprs BEFORE the SPMD
+partitioner runs, so the collectives XLA GSPMD inserts into the
+partition-layer programs (parallel/partition.py pjit/shard_map arms) are
+invisible to both.  This subpackage closes that gap: every mesh-capable
+cached factory is lowered under representative virtual-device meshes on
+XLA:CPU, the **post-SPMD optimized HLO** (``lower(...).compile()
+.as_text()`` — nothing executes beyond compilation) is parsed for
+collectives (hlo.py), and rules + per-program comms budgets gate against
+the committed ``COMMS_BASELINE.json`` (audit.py, ``python -m
+blockchain_simulator_tpu.lint.comms``).
+"""

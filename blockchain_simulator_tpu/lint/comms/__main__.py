@@ -1,16 +1,17 @@
-"""CLI: ``python -m blockchain_simulator_tpu.lint.graph``.
+"""CLI: ``python -m blockchain_simulator_tpu.lint.comms``.
 
-Flags mirror jaxlint's where the concept is shared (``--format``,
-``--baseline``, ``--no-baseline``, ``--write-baseline``,
-``--prune-baseline``, ``--list-rules``) plus graph-only ones
-(``--list-programs``, ``--only``, ``--tolerance``).
-Exit codes: 0 = clean vs baseline, 1 = new findings, 2 = a program failed
-to trace / bad baseline / usage error.
+Flags mirror the jaxgraph CLI exactly (``--format``, ``--baseline``,
+``--no-baseline``, ``--write-baseline``, ``--prune-baseline``,
+``--list-rules``, ``--list-programs``, ``--only``, ``--tolerance``).
+Exit codes: 0 = clean vs baseline, 1 = new findings, 2 = a mesh program
+failed to compile / bad baseline / usage error.
 
-The audit runs on the CPU backend by default regardless of this
-environment's TPU-tunnel plugin: a CI lint gate must never hang on a
-wedged tunnel (KNOWN_ISSUES.md #3), and the IR contracts it checks are
-backend-independent.  Override with ``$BLOCKSIM_GRAPH_PLATFORM``.
+The audit compiles on the CPU backend with 8 forced host devices
+regardless of this environment's TPU-tunnel plugin: the committed
+contract is the CPU-lowered SPMD HLO (deterministic, CI-runnable, no
+wedged-tunnel hangs — KNOWN_ISSUES.md #3), not measured interconnect
+time.  Override with ``$BLOCKSIM_GRAPH_PLATFORM`` (shared with the graph
+audit — same backend, one stage later).
 """
 
 from __future__ import annotations
@@ -20,57 +21,31 @@ import json
 import os
 import sys
 
-
-def _force_platform() -> None:
-    """Pin the audit backend BEFORE any jax import/backend init.  Mirrors
-    tests/conftest.py: env for the host-device-count flag, config for this
-    environment's sitecustomize (which forces jax_platforms='axon,cpu' at
-    the config level, so the env var alone is not enough)."""
-    platform = os.environ.get("BLOCKSIM_GRAPH_PLATFORM", "cpu")
-    if "jax" not in sys.modules:
-        os.environ.setdefault("JAX_PLATFORMS", platform)
-    # the host-device-count flag is read at backend INIT, not jax import —
-    # this environment's sitecustomize imports jax at interpreter start, so
-    # gate on backend state rather than sys.modules
-    backend_up = False
-    if "jax" in sys.modules:
-        try:
-            from jax._src import xla_bridge
-
-            backend_up = bool(getattr(xla_bridge, "_backends", None))
-        except Exception:
-            pass
-    flags = os.environ.get("XLA_FLAGS", "")
-    if not backend_up and "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8"
-        ).strip()
-    import jax
-
-    jax.config.update("jax_platforms", platform)
+from blockchain_simulator_tpu.lint.graph.__main__ import _force_platform
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
-        prog="blockchain_simulator_tpu.lint.graph",
-        description="jaxgraph: IR-level audit of every registered "
-                    "executable factory (jaxpr rules + FLOP/byte budget "
-                    "gate)",
+        prog="blockchain_simulator_tpu.lint.comms",
+        description="shardlint: post-SPMD communication audit of every "
+                    "mesh-capable factory (collective extraction + "
+                    "per-mesh comms budget gate)",
     )
     p.add_argument("--format", choices=("text", "json"), default="text")
     p.add_argument("--baseline", default=None,
-                   help="baseline file (default: GRAPH_BASELINE.json at the "
+                   help="baseline file (default: COMMS_BASELINE.json at the "
                         "repo root when present)")
     p.add_argument("--no-baseline", action="store_true",
                    help="report every finding and skip the budget gate")
     p.add_argument("--write-baseline", action="store_true",
-                   help="write current findings + measured budgets as the "
-                        "new baseline (preserves justifications) and exit 0")
+                   help="write current findings + measured comms budgets as "
+                        "the new baseline (preserves justifications) and "
+                        "exit 0")
     p.add_argument("--prune-baseline", action="store_true",
                    help="baseline hygiene: drop finding entries the audit "
                         "no longer produces and budgets for programs no "
-                        "longer in the catalog (retired factories); never "
-                        "re-pins live budgets or touches justifications")
+                        "longer in the catalog; never re-pins live budgets "
+                        "or touches justifications")
     p.add_argument("--list-rules", action="store_true")
     p.add_argument("--list-programs", action="store_true")
     p.add_argument("--only", nargs="*", default=None, metavar="PROGRAM",
@@ -78,11 +53,12 @@ def main(argv=None) -> int:
                         "completeness rule and runs.jsonl recording)")
     p.add_argument("--tolerance", type=float, default=None,
                    help="budget growth fraction that fails the gate "
-                        "(default: the baseline file's, else 0.25)")
+                        "(default: the baseline file's, else 0.25); growth "
+                        "from a zero pin always fails")
     args = p.parse_args(argv)
 
-    from blockchain_simulator_tpu.lint.graph import audit as audit_mod
-    from blockchain_simulator_tpu.lint.graph import programs as prog_mod
+    from blockchain_simulator_tpu.lint.comms import audit as audit_mod
+    from blockchain_simulator_tpu.lint.comms import programs as prog_mod
 
     if args.list_rules:
         for rid, summary in sorted(audit_mod.RULE_SUMMARIES.items()):
@@ -92,9 +68,7 @@ def main(argv=None) -> int:
     specs = prog_mod.build_catalog()
     if args.list_programs:
         for s in specs:
-            extra = f"  [group {s.divergence_group}]" if s.divergence_group \
-                else ""
-            print(f"{s.program:<28} factory={s.factory}{extra}")
+            print(f"{s.program:<36} factory={s.factory}")
         return 0
 
     subset = args.only is not None
@@ -102,30 +76,31 @@ def main(argv=None) -> int:
         known = {s.program for s in specs}
         unknown = [x for x in args.only if x not in known]
         if unknown:
-            print(f"jaxgraph: unknown program(s): {', '.join(unknown)}",
+            print(f"shardlint: unknown program(s): {', '.join(unknown)}",
                   file=sys.stderr)
             return 2
         specs = [s for s in specs if s.program in args.only]
 
     if args.prune_baseline:
-        # guard BEFORE the (minutes-long) audit: a subset run cannot
-        # distinguish retired from out-of-scope, and pruning needs a file
+        # guard BEFORE the (minutes-long) audit — same as jaxgraph
         if subset:
-            print("jaxgraph: --prune-baseline needs a full catalog run "
+            print("shardlint: --prune-baseline needs a full catalog run "
                   "(drop --only)", file=sys.stderr)
             return 2
         prune_path = args.baseline or audit_mod.default_baseline_path()
         if args.no_baseline or not os.path.exists(prune_path):
-            print(f"jaxgraph: --prune-baseline needs an existing baseline "
+            print(f"shardlint: --prune-baseline needs an existing baseline "
                   f"({prune_path})", file=sys.stderr)
             return 2
 
     _force_platform()
 
-    factories = prog_mod.discover_factories()
+    from blockchain_simulator_tpu.lint.graph.programs import (
+        discover_mesh_factories,
+    )
+
+    factories = discover_mesh_factories()
     if subset:
-        # a subset run cannot claim completeness — silence the rule by
-        # scoping discovery to the covered factories
         factories = {k: v for k, v in factories.items()
                      if k in {s.factory for s in specs}}
     result = audit_mod.run_audit(specs, factories)
@@ -137,24 +112,17 @@ def main(argv=None) -> int:
         try:
             baseline = audit_mod.load_baseline(baseline_path)
         except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
-            print(f"jaxgraph: bad baseline {baseline_path}: {e}",
+            print(f"shardlint: bad baseline {baseline_path}: {e}",
                   file=sys.stderr)
             return 2
     tolerance = args.tolerance if args.tolerance is not None \
         else baseline["tolerance"]
 
     if args.write_baseline:
-        # budgets must exist to be written; missing cost is an error either way
-        audit_mod.apply_budgets(result, {}, tolerance)
-        result.findings = [
-            f for f in result.findings if f.rule != "budget-missing"
-        ]
         if result.errors:
             for e in result.errors:
-                print(f"jaxgraph: {e}", file=sys.stderr)
+                print(f"shardlint: {e}", file=sys.stderr)
             return 2
-        # load old from disk regardless of --no-baseline: a rewrite must
-        # never lose hand-written justifications (jaxlint's write path)
         old = None
         if os.path.exists(baseline_path):
             try:
@@ -164,7 +132,7 @@ def main(argv=None) -> int:
         doc = audit_mod.write_baseline(baseline_path, result, old,
                                        tolerance=args.tolerance,
                                        full=not subset)
-        print(f"jaxgraph: wrote {len(doc['budgets'])} budget(s) and "
+        print(f"shardlint: wrote {len(doc['budgets'])} budget(s) and "
               f"{len(doc['entries'])} finding entr(ies) to "
               f"{baseline_path}")
         return 0
@@ -172,16 +140,16 @@ def main(argv=None) -> int:
     if args.prune_baseline:
         if result.errors:
             for e in result.errors:
-                print(f"jaxgraph: {e}", file=sys.stderr)
+                print(f"shardlint: {e}", file=sys.stderr)
             return 2
         info = audit_mod.prune_baseline(baseline_path, result, baseline)
         for r, pr, d in info["dropped_entries"]:
-            print(f"jaxgraph: pruned fixed entry {r} @ {pr}: {d!r}")
+            print(f"shardlint: pruned fixed entry {r} @ {pr}: {d!r}")
         for r, pr, d in info["shrunk_entries"]:
-            print(f"jaxgraph: shrank overcounted entry {r} @ {pr}: {d!r}")
+            print(f"shardlint: shrank overcounted entry {r} @ {pr}: {d!r}")
         for pr in info["dropped_budgets"]:
-            print(f"jaxgraph: dropped retired budget {pr}")
-        print(f"jaxgraph: pruned {len(info['dropped_entries'])} entr(ies), "
+            print(f"shardlint: dropped retired budget {pr}")
+        print(f"shardlint: pruned {len(info['dropped_entries'])} entr(ies), "
               f"shrank {len(info['shrunk_entries'])}, dropped "
               f"{len(info['dropped_budgets'])} retired budget(s) in "
               f"{baseline_path}")
@@ -192,13 +160,12 @@ def main(argv=None) -> int:
     new, n_baselined, stale = audit_mod.split_by_baseline(
         result.findings, {} if args.no_baseline else baseline["entries"]
     )
-    # entries for programs a subset run did not trace are not stale
     if subset:
         stale = [k for k in stale if k[1] in result.reports]
 
     if args.format == "json":
         print(json.dumps({
-            "jaxgraph_schema": 1,
+            "shardlint_schema": 1,
             "programs": {k: r.to_dict() for k, r in
                          sorted(result.reports.items())},
             "new_findings": [f.to_dict() for f in new],
@@ -217,36 +184,31 @@ def main(argv=None) -> int:
     else:
         for name in sorted(result.reports):
             r = result.reports[name]
-            cost = (f"gflops={r.cost['flops'] / 1e9:.6f} "
-                    f"mbytes={r.cost['bytes'] / 1e6:.3f}"
-                    if r.cost else "cost=n/a")
-            if r.memory:
-                cost += (f" temp_mb={r.memory['temp_bytes'] / 1e6:.3f} "
-                         f"arg_mb={r.memory['argument_bytes'] / 1e6:.3f}")
-            prims = (" " + ",".join(f"{k}x{v}" for k, v in
-                                    sorted(r.prims.items()))
-                     if r.prims else "")
-            print(f"{name:<28} [{r.factory}] {r.fingerprint[:12]} "
-                  f"eqns={r.n_eqns} {cost}{prims}")
+            mesh = "x".join(f"{k}={v}" for k, v in sorted(r.mesh.items()))
+            t = r.totals
+            print(f"{name:<36} [{r.factory}/{r.arm or '?'} {mesh}] "
+                  f"colls={t['collectives']} "
+                  f"({t['loop_collectives']} in loop) "
+                  f"kb={t['bytes'] / 1e3:.3f} "
+                  f"loop_kb={t['loop_bytes'] / 1e3:.3f}")
         for f in new:
             print(f"{f.program}: {f.rule}: {f.message}")
         for r, pr, d in stale:
-            print(f"jaxgraph: stale baseline entry {r} @ {pr}: {d!r} "
+            print(f"shardlint: stale baseline entry {r} @ {pr}: {d!r} "
                   "(fixed? regenerate with --write-baseline)",
                   file=sys.stderr)
         for pr, ax, m, pin in result.stale_budgets:
-            print(f"jaxgraph: stale budget {pr}.{ax}: measured {m:.0f} well "
-                  f"under pin {pin:.0f} (improvement — re-pin with "
+            print(f"shardlint: stale budget {pr}.{ax}: measured {m:.0f} "
+                  f"well under pin {pin:.0f} (improvement — re-pin with "
                   "--write-baseline)", file=sys.stderr)
         for e in result.errors:
-            print(f"jaxgraph: ERROR {e}", file=sys.stderr)
-        print(f"jaxgraph: {len(result.reports)} programs, "
-              f"{len(result.factories)} factories, {len(new)} new "
+            print(f"shardlint: ERROR {e}", file=sys.stderr)
+        print(f"shardlint: {len(result.reports)} programs, "
+              f"{len(result.factories)} mesh factories, {len(new)} new "
               f"finding(s), {n_baselined} baselined, "
               f"{len(result.errors)} error(s)")
 
-    # gate-equivalent runs leave the trail in runs.jsonl next to jaxlint's
-    # (no-op unless $BLOCKSIM_RUNS_JSONL is set; obs never inits a backend)
+    # gate-equivalent runs leave the trail in runs.jsonl next to jaxgraph's
     gate_equivalent = (
         not subset and not args.no_baseline and args.baseline is None
     )
@@ -254,7 +216,7 @@ def main(argv=None) -> int:
         from blockchain_simulator_tpu.utils import obs
 
         obs.record_run({
-            "metric": "jaxgraph_new_findings",
+            "metric": "comms_new_findings",
             "value": len(new),
             "unit": "findings",
             "programs": len(result.reports),
@@ -263,18 +225,14 @@ def main(argv=None) -> int:
         })
         for name in sorted(result.reports):
             r = result.reports[name]
-            if not (r.budget and r.cost):
-                continue
-            safe = name.replace(".", "_").replace("-", "_")
+            safe = (name.replace(".", "_").replace("-", "_")
+                    .replace("@", "_"))
             obs.record_run({
-                "metric": f"graph_{safe}_gflops",
-                "value": round(r.cost["flops"] / 1e9, 9),
-                "unit": "gflops",
-            })
-            obs.record_run({
-                "metric": f"graph_{safe}_bytes",
-                "value": r.cost["bytes"],
+                "metric": f"comms_{safe}_bytes",
+                "value": r.totals["bytes"],
                 "unit": "bytes",
+                "loop_bytes": r.totals["loop_bytes"],
+                "collectives": r.totals["collectives"],
             })
 
     if result.errors:

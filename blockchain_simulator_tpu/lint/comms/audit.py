@@ -1,0 +1,475 @@
+"""shardlint audit engine: compile the mesh catalog, lint the collectives.
+
+Mechanics deliberately mirror ``lint/graph/audit.py`` (exit 1 on
+non-baselined findings, 2 on infrastructure errors, committed
+``COMMS_BASELINE.json`` with per-entry justifications, shared
+lint/baseline.py count semantics) — but the ground truth is one stage
+later in the pipeline: the **post-SPMD optimized HLO**
+(``lower(...).compile().as_text()``), where every collective GSPMD
+inserted to satisfy the declared shardings is a real instruction.
+
+The budget section pins each mesh program's communication structure on
+four axes — total collective count and bytes-moved-per-device, and the
+same pair restricted to while/scan loop bodies (a per-TICK cost, the
+expensive kind).  Unlike the jaxgraph FLOP gate, comms budgets gate
+growth from ZERO: a program whose pin says "no collectives in the tick
+loop" fails the moment one appears, tolerance notwithstanding — there is
+no 25% of nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+from blockchain_simulator_tpu.lint import baseline as baseline_mod
+from blockchain_simulator_tpu.lint.comms import hlo
+from blockchain_simulator_tpu.lint.comms import programs as prog_mod
+
+BASELINE_NAME = "COMMS_BASELINE.json"
+REPO_ROOT = prog_mod.REPO_ROOT
+
+# Budget growth beyond this fraction of the pinned value fails the gate
+# (growth from a zero pin always fails — see apply_budgets).
+DEFAULT_TOLERANCE = 0.25
+
+# Declared-sharded operands below this global byte size may lower
+# replicated without a finding: GSPMD legitimately keeps small operands
+# everywhere, and replicating 200 bytes is not the failure mode the rule
+# exists for (a full gossip table materialized on every device is).
+LARGE_OPERAND_BYTES = 1024
+
+
+@dataclasses.dataclass
+class CommsFinding:
+    """One communication-contract violation for one mesh program."""
+
+    rule: str
+    program: str   # "<family>.<arm>@<mesh tag>" or a factory name
+    detail: str    # stable identity within (rule, program)
+    message: str
+    count: int = 1
+
+    def key(self) -> tuple[str, str, str]:
+        return (self.rule, self.program, self.detail)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+RULE_SUMMARIES = {
+    "table-regather": (
+        "all-gather output matches the FULL global shape of a "
+        "P(\"nodes\")-declared operand (the partitioner is rematerializing "
+        "a sharded table on every device)"
+    ),
+    "collective-in-tick-loop": (
+        "collective inside a while/scan body — a per-TICK communication "
+        "cost; every occurrence must be baselined with a justification"
+    ),
+    "unsharded-large-operand": (
+        f"declared-sharded operand >= {LARGE_OPERAND_BYTES} global bytes "
+        "still enters the entry computation at its full global shape "
+        "(lowered fully replicated despite its rule)"
+    ),
+    "resharding-churn": (
+        "the same value crosses more than one collective per loop "
+        "iteration (gather->scatter ping-pong or duplicate resharding of "
+        "one operand)"
+    ),
+    "unaudited-mesh-factory": (
+        "mesh-capable cached_factory registration with no covering comms "
+        "spec (grow lint/comms/programs.py with the factory)"
+    ),
+    "budget-missing": (
+        "mesh program has no pinned comms budget in COMMS_BASELINE.json "
+        "(pin with --write-baseline)"
+    ),
+    "budget-regression": (
+        "program's collective count or bytes-moved-per-device grew beyond "
+        "tolerance over its pin — or appeared where the pin says zero"
+    ),
+}
+
+# The pinned budget axes: collective count and output-shape bytes per
+# device, total and loop-body-only.  The loop axes are the ones that
+# matter at scale — a prologue all-gather runs once, a tick-body one runs
+# sim_ms times.
+BUDGET_AXES = ("collectives", "bytes", "loop_collectives", "loop_bytes")
+
+
+@dataclasses.dataclass
+class ProgramReport:
+    """Everything measured about one compiled mesh program."""
+
+    program: str
+    factory: str
+    mesh: dict                   # {axis: size} (size-1 axes included)
+    arm: str | None              # partition.partition_arm tag, if tagged
+    collectives: list            # [Collective.to_dict()]
+    totals: dict                 # {axis: number} over BUDGET_AXES
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class AuditResult:
+    reports: dict                 # {program: ProgramReport}
+    findings: list                # [CommsFinding], pre-baseline
+    errors: list                  # ["spec: message"] — exit-2 material
+    factories: dict               # discovered mesh {factory: [files]}
+    uncovered: list               # factory names with no comms spec
+    stale_budgets: list           # [(program, axis, measured, pinned)]
+
+
+def compile_spmd(fn, example_args) -> str:
+    """Aval-level args -> the post-SPMD optimized HLO module text.
+    Compilation only; nothing executes."""
+    import jax
+
+    # one-shot audit compile, not a hot path — there is no cache to miss
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)  # jaxlint: disable=static-arg-recompile-hazard
+    return jitted.lower(*example_args).compile().as_text()
+
+
+def _totals(colls) -> dict:
+    return {
+        "collectives": len(colls),
+        "bytes": float(sum(c.bytes for c in colls)),
+        "loop_collectives": sum(1 for c in colls if c.in_loop),
+        "loop_bytes": float(sum(c.bytes for c in colls if c.in_loop)),
+    }
+
+
+def _operand_detail(dims, hlo_dtype: str) -> str:
+    return f"{hlo_dtype}[{','.join(str(d) for d in dims)}]"
+
+
+def check_program(program: str, module, colls, meta,
+                  large_operand_bytes: int = LARGE_OPERAND_BYTES):
+    """The per-program comms rules, on a parsed module + spec metadata.
+    Split out from :func:`run_audit` so tests can feed crafted HLO."""
+    findings: list[CommsFinding] = []
+
+    # Declared node-sharded operands in the HLO dialect.
+    declared = []
+    for dims, np_dtype in meta.get("sharded_operands", ()):
+        dt = hlo.NUMPY_TO_HLO.get(str(np_dtype))
+        if dt is not None:
+            declared.append((tuple(dims), dt))
+
+    # table-regather: an all-gather whose output contains the FULL global
+    # shape of a declared-sharded operand — the table is back on every
+    # device, exactly what the node-dim sharding exists to prevent.
+    for dims, dt in declared:
+        hits = [
+            c for c in colls
+            if c.opcode == "all-gather" and (dt, dims) in hlo.shape_dims(c.shape)
+        ]
+        if hits:
+            placement = ("inside the tick loop"
+                         if any(c.in_loop for c in hits) else "in the prologue")
+            findings.append(CommsFinding(
+                rule="table-regather", program=program,
+                detail=_operand_detail(dims, dt), count=len(hits),
+                message=(
+                    f"`{program}` all-gathers the full global shape "
+                    f"{_operand_detail(dims, dt)} of a P(\"nodes\")-declared "
+                    f"operand ({len(hits)}x, {placement}): the partitioner "
+                    "is rematerializing the sharded table on every device — "
+                    "the consumer indexes it globally; reroute through the "
+                    "local shard (KNOWN_ISSUES #0p)"
+                ),
+            ))
+
+    # collective-in-tick-loop: one finding per (opcode, shape) so the
+    # baseline entry reads as "this exact per-tick exchange, justified".
+    in_loop: dict[tuple, list] = {}
+    for c in colls:
+        if c.in_loop:
+            in_loop.setdefault((c.opcode, c.shape), []).append(c)
+    for (opcode, shape), group in sorted(in_loop.items()):
+        findings.append(CommsFinding(
+            rule="collective-in-tick-loop", program=program,
+            detail=f"{opcode} {shape}", count=len(group),
+            message=(
+                f"`{program}` runs `{opcode}` ({shape}, "
+                f"{group[0].bytes} B/device) x{len(group)} EVERY tick "
+                "(while/scan body): a per-iteration interconnect cost — "
+                "baseline it with a justification or hoist it out of the "
+                "loop"
+            ),
+        ))
+
+    # unsharded-large-operand: a declared-sharded operand whose full
+    # global shape still enters the post-SPMD entry computation — GSPMD
+    # lowered it replicated despite the matching rule.
+    params = hlo.entry_parameters(module)
+    for dims, dt in declared:
+        nbytes = hlo.shape_bytes(_operand_detail(dims, dt))
+        if nbytes < large_operand_bytes:
+            continue
+        hit = any((dt, dims) in hlo.shape_dims(shape) for _, shape in params)
+        if hit:
+            findings.append(CommsFinding(
+                rule="unsharded-large-operand", program=program,
+                detail=_operand_detail(dims, dt),
+                message=(
+                    f"`{program}` operand {_operand_detail(dims, dt)} "
+                    f"({nbytes} global bytes) was declared node-sharded but "
+                    "enters the entry computation at its FULL global shape: "
+                    "the partitioner replicated it (per-device memory scales "
+                    "with global N again)"
+                ),
+            ))
+
+    # resharding-churn: within one loop-body computation, the same value
+    # feeds >1 collective per iteration — either two collectives share an
+    # operand, or one directly consumes another's output.
+    by_name = {c.name: c for c in colls}
+    loop_colls = [c for c in colls if c.in_loop]
+    by_comp_operand: dict[tuple, list] = {}
+    for c in loop_colls:
+        for op in c.operands:
+            by_comp_operand.setdefault((c.computation, op), []).append(c)
+    churns: dict[str, int] = {}
+    for (_, op), group in sorted(by_comp_operand.items()):
+        if len(group) > 1:
+            detail = "+".join(sorted({c.opcode for c in group}))
+            churns[detail] = churns.get(detail, 0) + 1
+    for c in loop_colls:
+        for op in c.operands:
+            prod = by_name.get(op)
+            if prod is not None and prod.in_loop:
+                detail = f"{prod.opcode}->{c.opcode}"
+                churns[detail] = churns.get(detail, 0) + 1
+    for detail, count in sorted(churns.items()):
+        findings.append(CommsFinding(
+            rule="resharding-churn", program=program, detail=detail,
+            count=count,
+            message=(
+                f"`{program}` reshards one value through `{detail}` "
+                f"x{count} per tick: back-to-back collectives on the same "
+                "operand usually mean the intermediate sharding is wrong "
+                "(fix the rule, not the gather)"
+            ),
+        ))
+    return findings
+
+
+def run_audit(specs=None, factories=None,
+              large_operand_bytes: int = LARGE_OPERAND_BYTES) -> AuditResult:
+    """Compile every spec under its mesh and run every rule that needs no
+    baseline.  Budget findings attach separately (:func:`apply_budgets`)."""
+    if specs is None:
+        specs = prog_mod.build_catalog()
+    if factories is None:
+        from blockchain_simulator_tpu.lint.graph.programs import (
+            discover_mesh_factories,
+        )
+
+        factories = discover_mesh_factories()
+
+    reports: dict[str, ProgramReport] = {}
+    findings: list[CommsFinding] = []
+    errors: list[str] = []
+
+    for spec in specs:
+        try:
+            fn, example_args, meta = spec.build()
+            text = compile_spmd(fn, example_args)
+        except Exception as e:  # exit-2: mesh factories must stay compilable
+            errors.append(f"{spec.program}: {type(e).__name__}: {e}")
+            continue
+        module = hlo.parse_module(text)
+        colls = hlo.collectives(module)
+        reports[spec.program] = ProgramReport(
+            program=spec.program, factory=spec.factory,
+            mesh=dict(meta.get("mesh", {})), arm=meta.get("arm"),
+            collectives=[c.to_dict() for c in colls],
+            totals=_totals(colls),
+        )
+        findings.extend(check_program(
+            spec.program, module, colls, meta,
+            large_operand_bytes=large_operand_bytes,
+        ))
+
+    # completeness: every AST-discovered mesh factory is covered
+    covered = {s.factory for s in specs}
+    uncovered = sorted(set(factories) - covered)
+    for name in uncovered:
+        findings.append(CommsFinding(
+            rule="unaudited-mesh-factory", program=name,
+            detail=(factories[name] or ["?"])[0],
+            message=(
+                f"mesh-capable cached_factory(\"{name}\") registered in "
+                f"{', '.join(factories[name])} has no comms spec — add a "
+                "CommsSpec in lint/comms/programs.py so its collectives "
+                "stay under contract"
+            ),
+        ))
+
+    return AuditResult(
+        reports=reports, findings=findings, errors=errors,
+        factories=factories, uncovered=uncovered, stale_budgets=[],
+    )
+
+
+# ---------------------------------------------------------------- baseline
+
+def load_baseline(path: str) -> dict:
+    """COMMS_BASELINE.json -> {"budgets": {...}, "entries": {key: entry},
+    "tolerance": float}."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    return {
+        "budgets": doc.get("budgets", {}),
+        "entries": baseline_mod.load_entries(doc),
+        "tolerance": float(doc.get("tolerance", DEFAULT_TOLERANCE)),
+    }
+
+
+def apply_budgets(result: AuditResult, budgets: dict,
+                  tolerance: float) -> None:
+    """Attach budget-missing / budget-regression findings (and stale-budget
+    notes).  Comms budgets gate growth FROM ZERO: collectives appearing
+    where the pin says none always fail — tolerance is a ratio, and there
+    is no ratio over nothing."""
+    for name in sorted(result.reports):
+        rep = result.reports[name]
+        pin = budgets.get(name)
+        if pin is None:
+            result.findings.append(CommsFinding(
+                rule="budget-missing", program=name, detail="budget",
+                message=(
+                    f"`{name}` has no pinned comms budget (measured "
+                    f"{rep.totals['collectives']} collectives, "
+                    f"{rep.totals['bytes']:.0f} B/device, "
+                    f"{rep.totals['loop_collectives']} in the tick loop); "
+                    "pin with --write-baseline"
+                ),
+            ))
+            continue
+        for axis in BUDGET_AXES:
+            measured = float(rep.totals[axis])
+            pinned = float(pin.get(axis, 0.0))
+            if pinned <= 0:
+                if measured > 0:
+                    result.findings.append(CommsFinding(
+                        rule="budget-regression", program=name, detail=axis,
+                        message=(
+                            f"`{name}` {axis} grew from a ZERO pin to "
+                            f"{measured:.0f}: the baseline says this "
+                            "program moves nothing on this axis — a new "
+                            "collective appeared; fix the sharding or "
+                            "re-pin with --write-baseline and a "
+                            "justification in the PR"
+                        ),
+                    ))
+                continue
+            if measured > pinned * (1.0 + tolerance):
+                result.findings.append(CommsFinding(
+                    rule="budget-regression", program=name, detail=axis,
+                    message=(
+                        f"`{name}` {axis} grew {measured / pinned:.2f}x "
+                        f"over its pin ({measured:.0f} vs {pinned:.0f}, "
+                        f"tolerance +{tolerance:.0%}): the lowered SPMD "
+                        "program moves more data per device than the "
+                        "committed contract — shrink it or re-pin with "
+                        "--write-baseline and a justification in the PR"
+                    ),
+                ))
+            elif measured < pinned * (1.0 - tolerance):
+                result.stale_budgets.append((name, axis, measured, pinned))
+
+
+def split_by_baseline(findings, entries: dict):
+    """Shared count semantics (lint/baseline.py): an entry absorbs findings
+    up to its count; a finding whose count GREW stays new."""
+    return baseline_mod.split_by_baseline(findings, entries)
+
+
+_COMMENT = (
+    "Post-SPMD communication contract: per-program collective counts + "
+    "bytes-moved-per-device (total and tick-loop-only) keyed on "
+    "<program>@<mesh tag>, plus grandfathered rule findings — every "
+    "collective-in-tick-loop entry carries a justification for WHY that "
+    "per-tick exchange is the algorithm, not an accident.  Regenerate "
+    "with `python -m blockchain_simulator_tpu.lint.comms "
+    "--write-baseline` (justifications preserved); new mesh programs "
+    "must come in clean and budgeted."
+)
+
+
+def write_baseline(
+    path: str, result: AuditResult, old: dict | None = None,
+    tolerance: float | None = None, full: bool = True,
+) -> dict:
+    """Write measured budgets + current findings as the new baseline,
+    preserving old justifications.  ``full=False`` (an ``--only`` subset
+    run) preserves out-of-scope budgets and entries wholesale — the same
+    subset contract as the graph audit's write_baseline."""
+    old = old or {"budgets": {}, "entries": {},
+                  "tolerance": DEFAULT_TOLERANCE}
+    budgets = {
+        name: dict(rep.totals)
+        for name, rep in sorted(result.reports.items())
+    }
+    counts = baseline_mod.collapse_counts(
+        result.findings, skip_rules=("budget-missing", "budget-regression")
+    )
+    if not full:
+        audited = set(result.reports)
+        for name, pin in old["budgets"].items():
+            if name not in audited:
+                budgets[name] = pin
+        for key, entry in old["entries"].items():
+            if key[1] not in audited and key not in counts:
+                counts[key] = entry["count"]
+        budgets = dict(sorted(budgets.items()))
+    doc = {
+        "comms_baseline": 1,
+        "comment": _COMMENT,
+        "tolerance": tolerance if tolerance is not None
+        else old.get("tolerance", DEFAULT_TOLERANCE),
+        "budgets": budgets,
+        "entries": baseline_mod.merge_entries(counts, old["entries"]),
+    }
+    baseline_mod.dump_doc(path, doc)
+    return doc
+
+
+def prune_baseline(path: str, result: AuditResult, old: dict) -> dict:
+    """Baseline hygiene: keep only what the current catalog still
+    justifies.  Entry counts shrink to what ``result`` consumed (fixed
+    entries drop), budgets for retired programs drop, live budget VALUES
+    and justifications pass through untouched."""
+    consumed = baseline_mod.collapse_counts(
+        result.findings, skip_rules=("budget-missing", "budget-regression")
+    )
+    audited = set(result.reports)
+    dropped_budgets = sorted(set(old["budgets"]) - audited)
+    budgets = {name: pin for name, pin in sorted(old["budgets"].items())
+               if name in audited}
+    entries, dropped_entries, shrunk_entries = baseline_mod.prune_entries(
+        old["entries"], consumed
+    )
+    doc = {
+        "comms_baseline": 1,
+        "comment": _COMMENT,
+        "tolerance": old.get("tolerance", DEFAULT_TOLERANCE),
+        "budgets": budgets,
+        "entries": entries,
+    }
+    baseline_mod.dump_doc(path, doc)
+    return {
+        "dropped_entries": dropped_entries,
+        "shrunk_entries": shrunk_entries,
+        "dropped_budgets": dropped_budgets,
+    }
+
+
+def default_baseline_path() -> str:
+    return os.path.join(REPO_ROOT, BASELINE_NAME)

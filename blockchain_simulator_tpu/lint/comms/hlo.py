@@ -1,0 +1,304 @@
+"""Post-SPMD HLO text parsing: computations, collectives, loop placement.
+
+The auditor's ground truth is ``lowered.compile().as_text()`` — the
+optimized HLO module AFTER the GSPMD partitioner ran, so every collective
+XLA inserted to satisfy the declared shardings is a real instruction line
+(``%all-gather.3 = f32[16,4]{1,0} all-gather(...), channel_id=1, ...``).
+This module parses that text with no jax/XLA imports at all: pure string
+work, so rule tests can feed crafted HLO and the parser stays stable
+across the jax versions the repo straddles.
+
+Three layers:
+
+- :func:`parse_module`: the module text -> named computations, each a list
+  of :class:`Instr` (name, shape string, opcode, operand names, attrs);
+- :func:`loop_computations`: the set of computations transitively reachable
+  from any ``while`` instruction's body/condition — a collective inside one
+  of these runs EVERY iteration of the tick/scan loop (a per-tick cost),
+  anywhere else it is one-shot prologue/epilogue work;
+- :func:`collectives`: every collective instruction with its
+  bytes-moved-per-device.  The byte model is deliberately simple and
+  deterministic: the byte size of the collective's OUTPUT shape on one
+  device (dtype width x element count, tuples summed).  It is a proxy for
+  interconnect traffic, not a measurement — the audit pins the lowered
+  SPMD program's communication structure, not ICI time (README caveat).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+# HLO primitive byte widths.  Sub-byte (s4/u4) round up to 1: the audit
+# gates growth ratios, and XLA pads sub-byte types in practice anyway.
+DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1,
+}
+
+# numpy/jax dtype names -> HLO primitive names (spec metadata is declared
+# aval-side; the HLO text speaks the XLA dialect).
+NUMPY_TO_HLO = {
+    "bool": "pred",
+    "int8": "s8", "uint8": "u8", "int16": "s16", "uint16": "u16",
+    "int32": "s32", "uint32": "u32", "int64": "s64", "uint64": "u64",
+    "float16": "f16", "bfloat16": "bf16", "float32": "f32",
+    "float64": "f64", "complex64": "c64", "complex128": "c128",
+}
+
+# The audited collective opcodes (ISSUE 18).  Async pairs normalize to the
+# -start op and the -done half is skipped so nothing double-counts.
+COLLECTIVE_OPS = frozenset({
+    "all-gather", "all-reduce", "reduce-scatter", "all-to-all",
+    "collective-permute",
+})
+
+_SHAPE_TOKEN_RE = re.compile(r"([a-z][a-z0-9]*)\[([\d,]*)\]")
+_OPCODE_RE = re.compile(r"\s*([\w\-]+)\(")
+_OPERAND_NAME_RE = re.compile(r"%([\w.\-]+)")
+_CALLED_RE = re.compile(
+    r"(?:calls|to_apply|body|condition|branch_computations)="
+    r"(\{[^}]*\}|%?[\w.\-]+)"
+)
+_COMP_HEADER_RE = re.compile(
+    r"^(ENTRY\s+)?%?([\w.\-]+)\s*\(.*->.*\{\s*$"
+)
+_INSTR_SPLIT_RE = re.compile(r"^\s*(ROOT\s+)?%?([\w.\-]+)\s*=\s*(.*)$")
+
+
+def shape_bytes(shape: str) -> int:
+    """Byte size of one HLO shape string on one device.  ``f32[16,8]{1,0}``
+    -> 512; tuple shapes sum their elements; ``s32[]`` is 4 (a scalar);
+    token/opaque types contribute 0."""
+    total = 0
+    for m in _SHAPE_TOKEN_RE.finditer(shape):
+        width = DTYPE_BYTES.get(m.group(1))
+        if width is None:
+            continue
+        n = 1
+        for d in m.group(2).split(","):
+            if d:
+                n *= int(d)
+        total += n * width
+    return total
+
+
+def shape_dims(shape: str) -> list[tuple[str, tuple[int, ...]]]:
+    """Every ``(dtype, dims)`` array in an HLO shape string (tuples yield
+    one record per element)."""
+    out = []
+    for m in _SHAPE_TOKEN_RE.finditer(shape):
+        if m.group(1) not in DTYPE_BYTES:
+            continue
+        dims = tuple(int(d) for d in m.group(2).split(",") if d)
+        out.append((m.group(1), dims))
+    return out
+
+
+@dataclasses.dataclass
+class Instr:
+    """One HLO instruction line."""
+
+    name: str
+    shape: str          # the result shape string (possibly a tuple)
+    opcode: str
+    operands: list      # operand instruction names (no %)
+    attrs: str          # everything after the operand list
+
+    def callees(self) -> list[str]:
+        """Computation names this instruction calls (body/condition/
+        to_apply/calls/branch_computations attributes)."""
+        names = []
+        for m in _CALLED_RE.finditer(self.attrs):
+            val = m.group(1)
+            if val.startswith("{"):
+                for part in val[1:-1].split(","):
+                    part = part.strip().lstrip("%")
+                    if part:
+                        names.append(part)
+            else:
+                names.append(val.lstrip("%"))
+        return names
+
+
+@dataclasses.dataclass
+class HloModule:
+    """Parsed module: ``{computation name: [Instr]}`` plus the entry name."""
+
+    computations: dict
+    entry: str | None
+
+
+def _split_shape(rhs: str) -> tuple[str, str]:
+    """Split ``rhs`` (everything after ``name = ``) into (shape, rest).
+    Tuple shapes balance parens; array shapes are one whitespace token."""
+    rhs = rhs.lstrip()
+    if rhs.startswith("("):
+        depth = 0
+        for i, ch in enumerate(rhs):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    return rhs[: i + 1], rhs[i + 1:]
+        return rhs, ""
+    m = re.match(r"\S+", rhs)
+    if m is None:
+        return "", rhs
+    return m.group(0), rhs[m.end():]
+
+
+def _parse_instr(line: str) -> Instr | None:
+    m = _INSTR_SPLIT_RE.match(line)
+    if m is None:
+        return None
+    name, rhs = m.group(2), m.group(3)
+    shape, rest = _split_shape(rhs)
+    om = _OPCODE_RE.match(rest)
+    if om is None:
+        return None
+    opcode = om.group(1)
+    # operand list: balance parens from the opcode's opening one
+    depth, i = 0, om.end() - 1
+    start = i + 1
+    while i < len(rest):
+        if rest[i] == "(":
+            depth += 1
+        elif rest[i] == ")":
+            depth -= 1
+            if depth == 0:
+                break
+        i += 1
+    operand_str, attrs = rest[start:i], rest[i + 1:]
+    operands = _OPERAND_NAME_RE.findall(operand_str)
+    return Instr(name=name, shape=shape, opcode=opcode,
+                 operands=operands, attrs=attrs)
+
+
+def parse_module(text: str) -> HloModule:
+    """Optimized-HLO module text -> :class:`HloModule`."""
+    computations: dict = {}
+    entry = None
+    current: list | None = None
+    for line in text.splitlines():
+        stripped = line.strip()
+        if not stripped or stripped.startswith("//"):
+            continue
+        if stripped.startswith("HloModule"):
+            continue
+        hm = _COMP_HEADER_RE.match(stripped)
+        if hm is not None and " = " not in stripped:
+            name = hm.group(2)
+            current = []
+            computations[name] = current
+            if hm.group(1):
+                entry = name
+            continue
+        if stripped == "}":
+            current = None
+            continue
+        if current is None:
+            continue
+        instr = _parse_instr(stripped)
+        if instr is not None:
+            current.append(instr)
+    return HloModule(computations=computations, entry=entry)
+
+
+def call_edges(module: HloModule) -> dict:
+    """{computation: set(callee computations)} over every instruction."""
+    edges: dict = {}
+    for name, instrs in module.computations.items():
+        callees = set()
+        for ins in instrs:
+            callees.update(
+                c for c in ins.callees() if c in module.computations
+            )
+        edges[name] = callees
+    return edges
+
+
+def loop_computations(module: HloModule) -> set:
+    """Computations whose instructions run once per loop iteration: the
+    body/condition computations of every ``while`` instruction, expanded
+    transitively through call edges (fusions, to_apply reducers, nested
+    conds all inherit the per-iteration placement)."""
+    edges = call_edges(module)
+    seeds: set = set()
+    for instrs in module.computations.values():
+        for ins in instrs:
+            if ins.opcode == "while":
+                seeds.update(
+                    c for c in ins.callees() if c in module.computations
+                )
+    reached: set = set()
+    stack = list(seeds)
+    while stack:
+        comp = stack.pop()
+        if comp in reached:
+            continue
+        reached.add(comp)
+        stack.extend(edges.get(comp, ()) - reached)
+    return reached
+
+
+@dataclasses.dataclass
+class Collective:
+    """One collective instruction with its per-device byte cost."""
+
+    name: str
+    opcode: str         # normalized (async -start pairs collapse)
+    computation: str
+    shape: str
+    bytes: int          # output-shape bytes per device (the proxy model)
+    in_loop: bool       # inside a while/scan body = a per-iteration cost
+    operands: list
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _normalize_opcode(opcode: str) -> str | None:
+    """Collective opcode for an instruction, or None when it is not an
+    audited collective.  ``*-start`` counts (once), ``*-done`` is the
+    other half of the same op and is skipped."""
+    if opcode.endswith("-done"):
+        return None
+    base = opcode[: -len("-start")] if opcode.endswith("-start") else opcode
+    return base if base in COLLECTIVE_OPS else None
+
+
+def collectives(module: HloModule) -> list:
+    """Every audited collective in the module, loop placement resolved."""
+    in_loop = loop_computations(module)
+    out = []
+    for comp, instrs in sorted(module.computations.items()):
+        for ins in instrs:
+            op = _normalize_opcode(ins.opcode)
+            if op is None:
+                continue
+            out.append(Collective(
+                name=ins.name, opcode=op, computation=comp,
+                shape=ins.shape, bytes=shape_bytes(ins.shape),
+                in_loop=comp in in_loop, operands=list(ins.operands),
+            ))
+    return out
+
+
+def entry_parameters(module: HloModule) -> list:
+    """The entry computation's ``parameter`` instructions as
+    ``(name, shape string)`` — post-SPMD these carry PER-DEVICE shapes, so
+    a declared-sharded operand that still shows its full global shape here
+    lowered replicated (the unsharded-large-operand rule's ground truth)."""
+    if module.entry is None:
+        return []
+    return [
+        (ins.name, ins.shape)
+        for ins in module.computations.get(module.entry, [])
+        if ins.opcode == "parameter"
+    ]

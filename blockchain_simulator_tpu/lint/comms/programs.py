@@ -1,0 +1,217 @@
+"""The comms-audit surface: every mesh-capable factory under real meshes.
+
+The jaxgraph catalog (lint/graph/programs.py) answers "what does the traced
+jaxpr look like"; this one answers "what does GSPMD DO to it" — so each
+spec here compiles a mesh-partitioned program and hands the auditor its
+post-SPMD HLO plus the metadata the rules key on: the mesh descriptor
+(partition.mesh_tag — part of the program name, so a 2-device pin never
+collides with a 4-device one), which partition() arm the factory took, and
+the avals of operands DECLARED node-dim-sharded (partition.node_dim_rules)
+— the table-regather / unsharded-large-operand ground truth.
+
+Completeness mirrors jaxgraph's: :func:`lint.graph.programs.
+discover_mesh_factories` finds every ``cached_factory`` registration whose
+function takes a ``mesh`` parameter by AST; a mesh factory with no spec
+here is an ``unaudited-mesh-factory`` finding.
+
+Meshes are the representative 2/4/8-virtual-device shapes of the CPU
+fallback box (tests/conftest.py forces 8 host devices): sweep-only shapes
+exercise the shard_map arm, nodes shapes the explicit-sharding pjit arm,
+mixed shapes both axes at once.  Audit-scale configs come from the shared
+``audit_configs()`` (n=8, exact sampler) so the two audits describe the
+same programs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+from blockchain_simulator_tpu.lint.graph import programs as graph_programs
+
+REPO_ROOT = graph_programs.REPO_ROOT
+
+_raw = graph_programs._raw
+_key_sds = graph_programs._key_sds
+_keys_sds = graph_programs._keys_sds
+_i32_sds = graph_programs._i32_sds
+
+
+@dataclasses.dataclass
+class CommsSpec:
+    """One mesh-compiled program of the comms audit surface.
+
+    ``build()`` (lazy — first jax touch) returns ``(fn, example_args,
+    meta)``: ``fn`` lowers/compiles on aval-level args; ``meta`` is
+    ``{"mesh": {axis: size}, "arm": str | None, "sharded_operands":
+    [(shape tuple, dtype str), ...]}`` — the operands the factory declared
+    node-dim-sharded, in GLOBAL view (what an all-gather must NOT
+    rematerialize)."""
+
+    program: str     # "<family>.<arm>@<mesh tag>" — the budget key
+    factory: str     # the cached_factory registry name this spec covers
+    build: Callable[[], tuple]
+
+
+def _mesh(n_node_shards: int, n_sweep: int):
+    from blockchain_simulator_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(n_node_shards=n_node_shards, n_sweep=n_sweep)
+
+
+def _meta(mesh, fn, sharded_operands=()):
+    from blockchain_simulator_tpu.parallel import partition
+
+    return {
+        "mesh": partition.mesh_shape_dict(mesh),
+        "arm": getattr(fn, "partition_arm", None),
+        "sharded_operands": [
+            (tuple(int(d) for d in a.shape), str(a.dtype))
+            for a in sharded_operands
+        ],
+    }
+
+
+def build_catalog() -> list[CommsSpec]:
+    """Every comms-audited program.  Lazy throughout — building the list
+    touches no backend; each spec's ``build`` does, on first compile."""
+    cfgs = graph_programs.audit_configs()
+    specs: list[CommsSpec] = []
+
+    # --- sweep.mesh_dyn_batched_fn ("partition-dyn-sweep") ---------------
+    # Every arm: sweep-only shard_map (2- and 4-device), nodes-only pjit,
+    # and the mixed 4-device mesh where GSPMD partitions both axes.
+    def partition_dynf_spec(sweep_n, node_n):
+        def build():
+            import dataclasses as _dc
+
+            from blockchain_simulator_tpu.parallel import partition, sweep
+
+            cfg = cfgs["pbft_tick"]
+            cfg = cfg.with_(faults=_dc.replace(cfg.faults, n_byzantine=1))
+            mesh = _mesh(node_n, sweep_n)
+            fn = _raw(sweep.mesh_dyn_batched_fn)(cfg, mesh)
+            b = max(sweep_n, 2)
+            args = (_keys_sds(b), _i32_sds((b,)), _i32_sds((b,)))
+            return fn, args, _meta(mesh, fn)
+
+        tag = "_".join(
+            p for p in (f"sweep{sweep_n}" if sweep_n > 1 else "",
+                        f"nodes{node_n}" if node_n > 1 else "") if p
+        )
+        return CommsSpec(f"partition_dynf.pbft@{tag}", "partition-dyn-sweep",
+                         build)
+
+    specs.append(partition_dynf_spec(2, 1))
+    specs.append(partition_dynf_spec(4, 1))
+    specs.append(partition_dynf_spec(1, 2))
+    specs.append(partition_dynf_spec(2, 2))
+
+    # --- sweep._batched_fn ("sweep-batched") -----------------------------
+    # The mesh arm vmaps the node-sharded sim with spmd_axis_name=sweep:
+    # batch over sweep, node state over nodes, both axes live at once.
+    def build_sweep_batched():
+        from blockchain_simulator_tpu.parallel import sweep
+
+        mesh = _mesh(2, 2)
+        fn = _raw(sweep._batched_fn)(cfgs["pbft_tick"], mesh)
+        return fn, (_keys_sds(2),), _meta(mesh, fn)
+
+    specs.append(CommsSpec("sweep_batched.pbft@sweep2_nodes2",
+                           "sweep-batched", build_sweep_batched))
+
+    # --- sweep.sharded_topo_sim_fn ("shard-topo-sim") --------------------
+    # The kregular pjit arm carries the [N_pad, K+1] overlay tables as
+    # P("nodes")-declared OPERANDS (sim.table_avals) — the exact surface
+    # the table-regather rule polices: an all-gather rematerializing a
+    # full global table shape would make the 10M-node story a lie.
+    def shard_topo_spec(arm, node_n):
+        def build():
+            import dataclasses as _dc
+
+            from blockchain_simulator_tpu.models.base import (
+                canonical_fault_cfg,
+            )
+            from blockchain_simulator_tpu.parallel import sweep
+
+            cfg = cfgs[arm]
+            cfg = cfg.with_(faults=_dc.replace(cfg.faults, n_crashed=1))
+            mesh = _mesh(node_n, 1)
+            sim = _raw(sweep.sharded_topo_sim_fn)(
+                canonical_fault_cfg(cfg), mesh
+            )
+            args = (_key_sds(), _i32_sds(), _i32_sds())
+            if hasattr(sim, "partitioned"):
+                return (
+                    sim.partitioned,
+                    args + tuple(sim.table_avals),
+                    _meta(mesh, sim.partitioned,
+                          sharded_operands=sim.table_avals),
+                )
+            return sim, args, _meta(mesh, sim)
+
+        return CommsSpec(f"shard_topo.{arm}@nodes{node_n}", "shard-topo-sim",
+                         build)
+
+    specs.append(shard_topo_spec("pbft_kreg", 2))
+    specs.append(shard_topo_spec("pbft_kreg", 4))
+    specs.append(shard_topo_spec("pbft_comm", 2))
+
+    # --- parallel/shard.py wrappers (shard_map arm, delivery collectives)
+    def shard_spec(program, factory, fget, arm, node_n=2):
+        def build():
+            mesh = _mesh(node_n, 1)
+            fn = fget()(cfgs[arm], mesh)
+            return fn, (_key_sds(),), _meta(mesh, fn)
+
+        return CommsSpec(f"{program}@nodes{node_n}", factory, build)
+
+    def _shard_mod():
+        from blockchain_simulator_tpu.parallel import shard
+
+        return shard
+
+    specs.append(shard_spec(
+        "shard.sim_tick", "shard-sim",
+        lambda: _raw(_shard_mod().make_sharded_sim_fn), "pbft_tick"))
+    specs.append(shard_spec(
+        "shard.sim_tick", "shard-sim",
+        lambda: _raw(_shard_mod().make_sharded_sim_fn), "pbft_tick",
+        node_n=8))
+    specs.append(shard_spec(
+        "shard.pbft_round", "shard-round",
+        lambda: _raw(_shard_mod()._make_sharded_round_fn), "pbft_round"))
+    specs.append(shard_spec(
+        "shard.raft_hb", "shard-raft-hb",
+        lambda: _raw(_shard_mod()._make_sharded_raft_hb_fn), "raft_hb"))
+    specs.append(shard_spec(
+        "shard.mixed_fast", "shard-mixed",
+        lambda: _raw(_shard_mod()._make_sharded_mixed_fast_fn),
+        "mixed_fast"))
+
+    # --- obsim/build.probed_mesh_fn ("consobs-mesh") ---------------------
+    # The armed twins: probes must not add collectives their disarmed
+    # twins (partition_dynf.* above) don't have.
+    def consobs_mesh_spec(sweep_n, node_n):
+        def build():
+            from blockchain_simulator_tpu.obsim import build as obsim_build
+            from blockchain_simulator_tpu.obsim import schema as obsim_schema
+
+            mesh = _mesh(node_n, sweep_n)
+            fn = _raw(obsim_build.probed_mesh_fn)(
+                cfgs["pbft_tick"], obsim_schema.ProbeConfig(), mesh
+            )
+            b = max(sweep_n, 2)
+            args = (_keys_sds(b), _i32_sds((b,)), _i32_sds((b,)))
+            return fn, args, _meta(mesh, fn)
+
+        tag = "_".join(
+            p for p in (f"sweep{sweep_n}" if sweep_n > 1 else "",
+                        f"nodes{node_n}" if node_n > 1 else "") if p
+        )
+        return CommsSpec(f"consobs.mesh@{tag}", "consobs-mesh", build)
+
+    specs.append(consobs_mesh_spec(2, 1))
+    specs.append(consobs_mesh_spec(1, 2))
+
+    return specs

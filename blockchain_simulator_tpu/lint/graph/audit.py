@@ -23,8 +23,8 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from collections import Counter
 
+from blockchain_simulator_tpu.lint import baseline as baseline_mod
 from blockchain_simulator_tpu.lint.graph import ir
 from blockchain_simulator_tpu.lint.graph import programs as prog_mod
 
@@ -88,14 +88,22 @@ RULE_SUMMARIES = {
         "(grow lint/graph/programs.py with the factory)"
     ),
     "budget-missing": (
-        "program has no pinned FLOP/byte budget in GRAPH_BASELINE.json "
-        "(pin with --write-baseline)"
+        "program has no pinned FLOP/byte/memory budget in "
+        "GRAPH_BASELINE.json (pin with --write-baseline)"
     ),
     "budget-regression": (
-        "program's analytical FLOP/byte cost grew beyond tolerance over "
-        "its pinned budget (static perf regression)"
+        "program's analytical FLOP/byte cost or compiled memory footprint "
+        "(peak temp + argument bytes) grew beyond tolerance over its "
+        "pinned budget (static perf regression)"
     ),
 }
+
+# The pinned budget axes: flops/bytes come from the analytical cost model
+# (Lowered.cost_analysis), temp_bytes/argument_bytes from the compiled
+# executable's memory_analysis() — peak XLA temp allocation and total
+# argument bytes per device.  Memory axes turn the RSS stories (7.4 GB @1M
+# nodes, 12.4 GB @4M — ROADMAP item 3) into pinned numbers instead of lore.
+BUDGET_AXES = ("flops", "bytes", "temp_bytes", "argument_bytes")
 
 
 @dataclasses.dataclass
@@ -106,6 +114,7 @@ class ProgramReport:
     factory: str
     fingerprint: str
     cost: dict | None            # {"flops", "bytes"} or None
+    memory: dict | None          # {"temp_bytes", "argument_bytes"} or None
     prims: dict                  # {primitive: count} (flagged subset)
     n_eqns: int
     const_bytes: int
@@ -224,6 +233,10 @@ def run_audit(specs=None, factories=None) -> AuditResult:
             factory=spec.factory,
             fingerprint=ir.fingerprint(closed),
             cost=ir.cost_summary(lowered),
+            # compiling is the expensive step — only the MEMORY_PINNED
+            # subset pays it (programs.py: the RSS-story representatives)
+            memory=ir.memory_summary(lowered)
+            if (spec.budget and getattr(spec, "memory", False)) else None,
             prims=flagged,
             n_eqns=sum(counts.values()),
             const_bytes=sum(b for _, _, b in ir.const_leaves(closed)),
@@ -284,17 +297,20 @@ def load_baseline(path: str) -> dict:
     "tolerance": float}."""
     with open(path, encoding="utf-8") as f:
         doc = json.load(f)
-    entries = {}
-    for e in doc.get("entries", []):
-        entries[(e["rule"], e["program"], e["detail"])] = {
-            "count": int(e.get("count", 1)),
-            "justification": e.get("justification", ""),
-        }
     return {
         "budgets": doc.get("budgets", {}),
-        "entries": entries,
+        "entries": baseline_mod.load_entries(doc),
         "tolerance": float(doc.get("tolerance", DEFAULT_TOLERANCE)),
     }
+
+
+def _measured_budget(rep: ProgramReport) -> dict:
+    """The measurable budget axes of one report, merged (cost axes +
+    compiled memory axes; absent surfaces simply omit their keys)."""
+    merged = dict(rep.cost or {})
+    if rep.memory:
+        merged.update(rep.memory)
+    return merged
 
 
 def apply_budgets(result: AuditResult, budgets: dict, tolerance: float) -> None:
@@ -310,21 +326,31 @@ def apply_budgets(result: AuditResult, budgets: dict, tolerance: float) -> None:
                 "(budget gate needs Lowered.cost_analysis())"
             )
             continue
+        measured_all = _measured_budget(rep)
         pin = budgets.get(name)
         if pin is None:
             result.findings.append(GraphFinding(
                 rule="budget-missing", program=name, detail="budget",
                 message=(
-                    f"`{name}` has no pinned FLOP/byte budget "
+                    f"`{name}` has no pinned FLOP/byte/memory budget "
                     f"(measured flops={rep.cost['flops']:.0f} "
                     f"bytes={rep.cost['bytes']:.0f}); pin with "
                     "--write-baseline"
                 ),
             ))
             continue
-        for axis in ("flops", "bytes"):
-            measured, pinned = rep.cost[axis], float(pin.get(axis, 0.0))
+        for axis in BUDGET_AXES:
+            measured, pinned = measured_all.get(axis), float(
+                pin.get(axis, 0.0)
+            )
             if pinned <= 0:
+                continue
+            if measured is None:
+                result.errors.append(
+                    f"{name}: budget axis {axis} is pinned but the backend "
+                    "measured nothing for it (compiled memory_analysis "
+                    "unavailable?)"
+                )
                 continue
             if measured > pinned * (1.0 + tolerance):
                 result.findings.append(GraphFinding(
@@ -344,23 +370,11 @@ def apply_budgets(result: AuditResult, budgets: dict, tolerance: float) -> None:
 def split_by_baseline(
     findings: list[GraphFinding], entries: dict
 ) -> tuple[list[GraphFinding], int, list[tuple]]:
-    """(new findings, n_baselined, stale entry keys) — count semantics match
-    lint/engine.py: an entry absorbs findings up to its count; a finding
-    whose count GREW past the entry's stays new (a program gaining scatters
-    is a change, not grandfather)."""
-    used: Counter = Counter()
-    new: list[GraphFinding] = []
-    n_baselined = 0
-    for f in findings:
-        key = f.key()
-        allowed = entries.get(key, {}).get("count", 0)
-        if f.count <= allowed - used[key]:
-            used[key] += f.count
-            n_baselined += 1
-        else:
-            new.append(f)
-    stale = [k for k, e in entries.items() if used[k] < e["count"]]
-    return new, n_baselined, stale
+    """(new findings, n_baselined, stale entry keys) — the shared count
+    semantics (lint/baseline.py): an entry absorbs findings up to its
+    count; a finding whose count GREW past the entry's stays new (a
+    program gaining scatters is a change, not grandfather)."""
+    return baseline_mod.split_by_baseline(findings, entries)
 
 
 def write_baseline(
@@ -378,18 +392,13 @@ def write_baseline(
     jaxlint's ``write_baseline(linted_paths=...)``."""
     old = old or {"budgets": {}, "entries": {}, "tolerance": DEFAULT_TOLERANCE}
     budgets = {
-        name: {"flops": rep.cost["flops"], "bytes": rep.cost["bytes"]}
+        name: _measured_budget(rep)
         for name, rep in sorted(result.reports.items())
         if rep.budget and rep.cost is not None
     }
-    # findings with one identical (rule, program, detail) key must collapse
-    # into ONE entry with summed count — load_baseline keys a dict, and a
-    # written baseline that fails its own next run would be useless
-    counts: Counter = Counter()
-    for f in result.findings:
-        if f.rule in ("budget-missing", "budget-regression"):
-            continue
-        counts[f.key()] += f.count
+    counts = baseline_mod.collapse_counts(
+        result.findings, skip_rules=("budget-missing", "budget-regression")
+    )
     if not full:
         audited = set(result.reports)
         for name, pin in old["budgets"].items():
@@ -399,33 +408,23 @@ def write_baseline(
             if key[1] not in audited and key not in counts:
                 counts[key] = entry["count"]
         budgets = dict(sorted(budgets.items()))
-    entries = []
-    for key, count in sorted(counts.items()):
-        rule, program, detail = key
-        just = old["entries"].get(key, {}).get(
-            "justification", "TODO: justify or fix"
-        )
-        entries.append({
-            "rule": rule, "program": program, "detail": detail,
-            "count": count, "justification": just,
-        })
     doc = {
         "jaxgraph_baseline": 1,
         "comment": (
-            "IR-level grandfathered findings + per-program analytical "
-            "FLOP/byte budgets (Lowered.cost_analysis, bit-stable).  "
-            "Regenerate with `python -m blockchain_simulator_tpu.lint.graph "
-            "--write-baseline` (justifications preserved); new programs "
-            "must come in clean and budgeted."
+            "IR-level grandfathered findings + per-program budgets: "
+            "analytical FLOP/byte cost (Lowered.cost_analysis) and "
+            "compiled memory footprint (memory_analysis peak temp + "
+            "argument bytes), all bit-stable.  Regenerate with `python -m "
+            "blockchain_simulator_tpu.lint.graph --write-baseline` "
+            "(justifications preserved); new programs must come in clean "
+            "and budgeted."
         ),
         "tolerance": tolerance if tolerance is not None
         else old.get("tolerance", DEFAULT_TOLERANCE),
         "budgets": budgets,
-        "entries": entries,
+        "entries": baseline_mod.merge_entries(counts, old["entries"]),
     }
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump(doc, f, indent=1, sort_keys=False)
-        f.write("\n")
+    baseline_mod.dump_doc(path, doc)
     return doc
 
 
@@ -441,47 +440,32 @@ def prune_baseline(path: str, result: AuditResult, old: dict) -> dict:
     Returns ``{"dropped_entries": [...], "shrunk_entries": [...],
     "dropped_budgets": [...]}``.  ``result`` must come from a FULL audit
     run (a subset run cannot distinguish retired from out-of-scope)."""
-    consumed: Counter = Counter()
-    for f in result.findings:
-        if f.rule in ("budget-missing", "budget-regression"):
-            continue
-        consumed[f.key()] += f.count
-
+    consumed = baseline_mod.collapse_counts(
+        result.findings, skip_rules=("budget-missing", "budget-regression")
+    )
     audited = set(result.reports)
     dropped_budgets = sorted(set(old["budgets"]) - audited)
     budgets = {name: pin for name, pin in sorted(old["budgets"].items())
                if name in audited}
-
-    dropped_entries, shrunk_entries, entries = [], [], []
-    for key, entry in sorted(old["entries"].items()):
-        rule, program, detail = key
-        live = min(entry["count"], consumed.get(key, 0))
-        if live == 0:
-            dropped_entries.append(key)
-            continue
-        if live < entry["count"]:
-            shrunk_entries.append(key)
-        entries.append({
-            "rule": rule, "program": program, "detail": detail,
-            "count": live, "justification": entry.get("justification", ""),
-        })
-
+    entries, dropped_entries, shrunk_entries = baseline_mod.prune_entries(
+        old["entries"], consumed
+    )
     doc = {
         "jaxgraph_baseline": 1,
         "comment": (
-            "IR-level grandfathered findings + per-program analytical "
-            "FLOP/byte budgets (Lowered.cost_analysis, bit-stable).  "
-            "Regenerate with `python -m blockchain_simulator_tpu.lint.graph "
-            "--write-baseline` (justifications preserved); new programs "
-            "must come in clean and budgeted."
+            "IR-level grandfathered findings + per-program budgets: "
+            "analytical FLOP/byte cost (Lowered.cost_analysis) and "
+            "compiled memory footprint (memory_analysis peak temp + "
+            "argument bytes), all bit-stable.  Regenerate with `python -m "
+            "blockchain_simulator_tpu.lint.graph --write-baseline` "
+            "(justifications preserved); new programs must come in clean "
+            "and budgeted."
         ),
         "tolerance": old.get("tolerance", DEFAULT_TOLERANCE),
         "budgets": budgets,
         "entries": entries,
     }
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump(doc, f, indent=1, sort_keys=False)
-        f.write("\n")
+    baseline_mod.dump_doc(path, doc)
     return {
         "dropped_entries": dropped_entries,
         "shrunk_entries": shrunk_entries,

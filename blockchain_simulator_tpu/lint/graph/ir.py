@@ -173,6 +173,23 @@ def cost_summary(lowered) -> dict | None:
     return aotcache.cost_of(lowered)
 
 
+def memory_summary(lowered) -> dict | None:
+    """{"temp_bytes", "argument_bytes"} from the COMPILED executable's
+    ``memory_analysis()`` — peak XLA temp allocation and total argument
+    bytes per device — or None when the backend provides none.  This is
+    the one audit step that pays a real compile (still nothing executes);
+    the budget gate pins it next to flops/bytes so the RSS stories
+    (7.4 GB @1M, 12.4 GB @4M nodes — ROADMAP item 3) regress loudly."""
+    try:
+        stats = lowered.compile().memory_analysis()
+        return {
+            "temp_bytes": float(stats.temp_size_in_bytes),
+            "argument_bytes": float(stats.argument_size_in_bytes),
+        }
+    except Exception:
+        return None
+
+
 def trace_program(fn, example_args: tuple):
     """Trace ``fn`` (jitted or plain) on aval-level ``example_args``;
     returns ``(closed_jaxpr, lowered)``.  Nothing executes: plain callables

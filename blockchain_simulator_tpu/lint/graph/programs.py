@@ -89,6 +89,68 @@ def discover_factories(paths: list[str] | None = None) -> dict[str, list[str]]:
     return found
 
 
+def _walk_py_files(paths: list[str] | None) -> list[str]:
+    if paths is None:
+        paths = [os.path.join(REPO_ROOT, "blockchain_simulator_tpu")]
+    files = []
+    for root in paths:
+        if os.path.isfile(root):
+            files.append(root)
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d != "__pycache__" and not d.startswith(".")
+            )
+            files.extend(
+                os.path.join(dirpath, fn)
+                for fn in sorted(filenames) if fn.endswith(".py")
+            )
+    return files
+
+
+def discover_mesh_factories(paths: list[str] | None = None) -> dict:
+    """{factory name: [repo-relative files]} of every ``cached_factory``
+    registration whose decorated function takes a ``mesh`` parameter —
+    the mesh-capable subset of :func:`discover_factories`, and the
+    completeness surface of the comms audit (lint/comms): a mesh factory
+    with no comms spec is an ``unaudited-mesh-factory`` finding, the
+    post-SPMD analog of ``unaudited-factory``.  Pure AST, same no-import
+    contract."""
+    found: dict = {}
+    for fp in _walk_py_files(paths):
+        try:
+            with open(fp, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            continue
+        aliases = common.import_aliases(tree)
+        rel = os.path.relpath(fp, REPO_ROOT).replace(os.sep, "/")
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            a = node.args
+            params = {
+                arg.arg for arg in
+                list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+            }
+            if "mesh" not in params:
+                continue
+            for dec in node.decorator_list:
+                if not (isinstance(dec, ast.Call) and dec.args):
+                    continue
+                if common.resolve(dec.func, aliases) not in _FACTORY_CALLS:
+                    continue
+                arg = dec.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str
+                ):
+                    found.setdefault(arg.value, [])
+                    if rel not in found[arg.value]:
+                        found[arg.value].append(rel)
+    return found
+
+
 @dataclasses.dataclass
 class ProgramSpec:
     """One traceable program of the audit surface.
@@ -99,13 +161,18 @@ class ProgramSpec:
     spec covers; specs sharing a ``divergence_group`` must trace to ONE
     fingerprint (the registry-key-divergence contract — one key, one
     executable).  ``budget=False`` skips the FLOP/byte pin (divergence
-    twins re-measure a primary program's graph)."""
+    twins re-measure a primary program's graph).  ``memory=True``
+    additionally COMPILES the program and pins its memory_analysis axes
+    (peak temp + argument bytes) — compilation costs real minutes across
+    the catalog, so only the representative programs whose RSS stories
+    the ROADMAP tracks opt in."""
 
     program: str
     factory: str
     build: Callable[[], tuple]
     divergence_group: str | None = None
     budget: bool = True
+    memory: bool = False
 
 
 # ------------------------------------------------------------- aval helpers
@@ -651,4 +718,24 @@ def build_catalog() -> list[ProgramSpec]:
     specs.append(consobs_mesh_spec("consobs.mesh_sweep", 2, 1, True))
     specs.append(consobs_mesh_spec("consobs.mesh_nodes", 1, 2, True))
 
+    for s in specs:
+        if s.program in MEMORY_PINNED:
+            s.memory = True
     return specs
+
+
+# The memory-pinned subset: one program per RSS story the ROADMAP tracks
+# (dense tick/round engines, the gather-overlay arms behind the 1M/4M-node
+# RSS numbers, the batched sweep, the sharded overlay, the serving solo
+# path).  Compiling is the expensive step — ~8 compiles keeps the gate
+# under a minute where pinning all ~34 budgeted programs costs 10+.
+MEMORY_PINNED = frozenset({
+    "sim.pbft_tick",
+    "sim.pbft_round",
+    "sim.raft_tick",
+    "sim.pbft_kreg",
+    "sim.pbft_comm",
+    "sweep_dynf.pbft",
+    "shard_topo.pbft_kreg",
+    "serve_solo.pbft",
+})

@@ -9,6 +9,7 @@ and ``check(ctx: common.RuleContext) -> list[common.Finding]``.
 from __future__ import annotations
 
 from blockchain_simulator_tpu.lint.rules import (  # noqa: F401
+    hardcoded_mesh_axis,
     host_sync_in_traced,
     module_scope_backend_touch,
     probe_child_kill,
@@ -26,6 +27,7 @@ ALL_RULES = [
     probe_child_kill,
     static_arg_recompile_hazard,
     unused_import,
+    hardcoded_mesh_axis,
 ]
 
 RULES_BY_ID = {mod.RULE_ID: mod for mod in ALL_RULES}

@@ -1,0 +1,145 @@
+"""hardcoded-mesh-axis: mesh vocabulary belongs to parallel/partition.py.
+
+The shardlint comms audit (lint/comms) pins WHAT the partitioner does to
+each mesh program; this rule pins WHERE the sharding vocabulary may be
+spelled.  The repo's contract is that mesh axis names live in
+``parallel/mesh.py`` (``NODES_AXIS``/``SWEEP_AXIS``) and PartitionSpec
+construction is partition-layer business (``parallel/partition.py``
+rules, ``node_dim_rules``, ``batched_out_shardings``): a ``P("nodes")``
+inlined in a model or engine file bypasses ``match_partition_rules``, so
+renaming an axis or reshaping the mesh silently strands it — the comms
+audit then reports the resulting replication as a table-regather, one PR
+too late.
+
+Two triggers, outside the allowed partition-layer files:
+
+- constructing ``jax.sharding.PartitionSpec`` (any alias, incl. the
+  conventional ``P``) — declare a rule in partition.py and match it;
+- passing a mesh axis-name string literal ("nodes"/"sweep") to a
+  sharding-vocabulary call (``PartitionSpec``/``NamedSharding``/
+  ``Mesh``/``shard_map``/``psum``-family) or ``axis_name=``-style
+  kwargs — import the constant from parallel/mesh.py instead.
+
+Existing partition-adjacent sites (parallel/shard.py's hand-written
+in_specs, sweep.py's overlay table specs, obsim's probe shardings) are
+grandfathered in LINT_BASELINE.json with justifications.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from blockchain_simulator_tpu.lint import common
+
+RULE_ID = "hardcoded-mesh-axis"
+SUMMARY = ("mesh axis-name literal or inline PartitionSpec outside "
+           "parallel/partition.py|mesh.py (bypasses match_partition_rules; "
+           "shardlint sees the fallout one PR late)")
+
+# The partition layer itself, where the vocabulary is DEFINED.
+ALLOWED_PATH_PARTS = (
+    "parallel/partition.py",
+    "parallel/mesh.py",
+)
+
+# The repo's mesh axis names (parallel/mesh.py NODES_AXIS / SWEEP_AXIS).
+AXIS_LITERALS = frozenset({"nodes", "sweep"})
+
+# Dotted call targets that consume sharding vocabulary.
+SPEC_CALLS = frozenset({
+    "jax.sharding.PartitionSpec",
+    "jax.sharding.NamedSharding",
+    "jax.sharding.Mesh",
+})
+AXIS_CONSUMER_ATTRS = frozenset({
+    "PartitionSpec", "NamedSharding", "Mesh", "shard_map",
+    "psum", "pmax", "pmin", "pmean", "all_gather", "ppermute",
+    "axis_index", "make_mesh",
+})
+AXIS_KWARGS = frozenset({
+    "axis_name", "axis_names", "spmd_axis_name", "mesh_axis",
+})
+
+
+def _call_name(call: ast.Call, aliases: dict[str, str]) -> str:
+    resolved = common.resolve(call.func, aliases)
+    if resolved:
+        return resolved
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return ""
+
+
+def _is_spec_ctor(call: ast.Call, aliases: dict[str, str]) -> bool:
+    name = _call_name(call, aliases)
+    if name in SPEC_CALLS:
+        return True
+    # the conventional `from jax.sharding import PartitionSpec as P`
+    return name.rsplit(".", 1)[-1] == "PartitionSpec"
+
+
+def _axis_literals_in(call: ast.Call) -> list[ast.Constant]:
+    hits = []
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        for node in ast.walk(arg):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and node.value in AXIS_LITERALS):
+                hits.append(node)
+    return hits
+
+
+def check(ctx: common.RuleContext) -> list[common.Finding]:
+    if any(part in ctx.path for part in ALLOWED_PATH_PARTS):
+        return []
+    findings: list[common.Finding] = []
+
+    def fn_of(node):
+        for parent in common.parent_chain(node):
+            if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return parent.name
+        return None
+
+    for call in ast.walk(ctx.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        if _is_spec_ctor(call, ctx.aliases):
+            findings.append(common.Finding(
+                rule=RULE_ID, path=ctx.path, line=call.lineno,
+                col=call.col_offset,
+                message=(
+                    "inline PartitionSpec construction outside the "
+                    "partition layer: declare a rule in parallel/"
+                    "partition.py (match_partition_rules / node_dim_rules) "
+                    "so axis renames and mesh reshapes stay one-file "
+                    "changes"
+                ),
+                end_line=getattr(call, "end_lineno", None),
+                function=fn_of(call),
+            ))
+            continue
+        name = _call_name(call, ctx.aliases)
+        consumes_axis = (
+            name in SPEC_CALLS
+            or name.rsplit(".", 1)[-1] in AXIS_CONSUMER_ATTRS
+            or any(kw.arg in AXIS_KWARGS for kw in call.keywords
+                   if kw.arg)
+        )
+        if not consumes_axis:
+            continue
+        for lit in _axis_literals_in(call):
+            findings.append(common.Finding(
+                rule=RULE_ID, path=ctx.path, line=lit.lineno,
+                col=lit.col_offset,
+                message=(
+                    f"mesh axis name {lit.value!r} hardcoded at a "
+                    f"sharding call ({name.rsplit('.', 1)[-1]}): import "
+                    "NODES_AXIS/SWEEP_AXIS from parallel/mesh.py — a "
+                    "renamed axis strands string literals silently"
+                ),
+                end_line=getattr(lit, "end_lineno", None),
+                function=fn_of(call),
+            ))
+    return findings

@@ -44,6 +44,14 @@ import numpy as np
 
 REPLICATED = None  # sentinel alias: a rule spec of None means "replicate"
 
+# The three compilation arms of :func:`partition`, as stable strings: every
+# partitioned callable is tagged with ``partition_arm`` / ``partition_mesh``
+# attributes (see _tag_arm) so the comms auditor (lint/comms) can report
+# WHICH door a program went through without re-deriving the dispatch.
+PJIT_ARM = "pjit"              # explicit shardings; XLA GSPMD partitions
+SHARD_MAP_ARM = "shard_map"    # per-shard specs; map-style collectives
+SINGLE_DEVICE_ARM = "single"   # size-1 mesh degenerate: plain jit
+
 
 def _spec_cls():
     from jax.sharding import PartitionSpec
@@ -108,6 +116,31 @@ def match_partition_rules(rules, tree):
                          f"(shape {tuple(shape)})")
 
     return jax.tree_util.tree_map_with_path(spec_for, tree)
+
+
+def mesh_tag(mesh) -> str:
+    """Stable mesh descriptor for program/budget keys: axis names and
+    sizes with size-1 axes elided (``"sweep2_nodes4"``), ``"single"`` for
+    a 1-device mesh.  The comms baseline (COMMS_BASELINE.json) keys every
+    budget on ``program@tag`` so a 2-device audit pin never collides with
+    a 4-device one."""
+    parts = [
+        f"{name}{size}"
+        for name, size in mesh_shape_dict(mesh).items() if int(size) > 1
+    ]
+    return "_".join(parts) if parts else "single"
+
+
+def _tag_arm(fn, mesh, arm):
+    """Best-effort arm/mesh metadata on a partitioned callable (jit
+    wrappers accept attributes on this jax; a C-level wrapper that refuses
+    just stays untagged — the metadata is advisory, never load-bearing)."""
+    try:
+        fn.partition_arm = arm
+        fn.partition_mesh = mesh_shape_dict(mesh)
+    except (AttributeError, TypeError):
+        pass
+    return fn
 
 
 def _shard_map(f, mesh, in_specs, out_specs):
@@ -196,11 +229,15 @@ def partition(fn, mesh, *, in_shardings=None, out_shardings=None,
                 with mesh:
                     return fn(*args)
 
-            return jax.jit(single_device_fn)  # jaxlint: disable=static-arg-recompile-hazard
-        return jax.jit(  # jaxlint: disable=static-arg-recompile-hazard
-            fn,
-            in_shardings=_named_shardings(mesh, in_shardings),
-            out_shardings=_named_shardings(mesh, out_shardings),
+            return _tag_arm(jax.jit(single_device_fn), mesh,  # jaxlint: disable=static-arg-recompile-hazard
+                            SINGLE_DEVICE_ARM)
+        return _tag_arm(
+            jax.jit(  # jaxlint: disable=static-arg-recompile-hazard
+                fn,
+                in_shardings=_named_shardings(mesh, in_shardings),
+                out_shardings=_named_shardings(mesh, out_shardings),
+            ),
+            mesh, PJIT_ARM,
         )
     if not mapped:
         raise ValueError(
@@ -209,11 +246,11 @@ def partition(fn, mesh, *, in_shardings=None, out_shardings=None,
         )
     shmapped = _shard_map(fn, mesh, in_specs, out_specs)
     if not wrap_jit:
-        return shmapped
+        return _tag_arm(shmapped, mesh, SHARD_MAP_ARM)
     import jax
 
     # cached one level up, same as the explicit-sharding arm above
-    return jax.jit(shmapped)  # jaxlint: disable=static-arg-recompile-hazard
+    return _tag_arm(jax.jit(shmapped), mesh, SHARD_MAP_ARM)  # jaxlint: disable=static-arg-recompile-hazard
 
 
 # ----------------------------------------------------- node-dim rule sets ---

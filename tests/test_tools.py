@@ -368,6 +368,9 @@ def test_lint_sh_chains_both_gates(tmp_path):
         # (tests/test_zsweep_cache.py); this smoke pins the lint+compare
         # gates.  GRAPH=0: the IR audit traces every factory (~1.5 min) —
         # its gate is covered end-to-end by tests/test_zzgraph.py.
+        # COMMS=0: shardlint compiles every mesh program under SPMD
+        # (~2.5 min) — covered by tests/test_zzcomms.py (rule units +
+        # the slow-marked full-audit exit-0 test).
         # SERVE=0: the serving smoke compiles a daemon's worth of
         # executables — covered by tests/test_zserve.py's self-test.
         # CHAOS=0: the chaos drill runs every scenario twice — covered by
@@ -383,10 +386,18 @@ def test_lint_sh_chains_both_gates(tmp_path):
         # covered by tests/test_ztick.py (bit-equality + executable pins).
         # TELEM=0: the telemetry report drives a warm in-process fleet —
         # covered by tests/test_zztelemetry.py (gates + slow CLI test).
+        # TOPO=0 / SHARD_TOPO=0: the topology smokes compile sparse and
+        # mesh-sharded overlay programs (~1 min each) — covered by
+        # tests/test_zztopo.py and tests/test_zzshardtopo.py.
+        # CONSOBS=0: the consensus-obs report compiles armed/disarmed
+        # twins (~2 min) — covered by tests/test_zzobsim.py.  Together
+        # those stages outgrew this smoke's 240 s budget; the chain
+        # itself is pinned by the script-contract asserts below.
         env={**os.environ, "BLOCKSIM_RUNS_JSONL": str(runs),
-             "WARM_BENCH": "0", "GRAPH": "0", "SERVE": "0", "CHAOS": "0",
-             "MESH_SWEEP": "0", "FLEET": "0", "RESUME": "0", "TICK": "0",
-             "TELEM": "0"},
+             "WARM_BENCH": "0", "GRAPH": "0", "COMMS": "0", "SERVE": "0",
+             "CHAOS": "0", "MESH_SWEEP": "0", "FLEET": "0", "RESUME": "0",
+             "TICK": "0", "TELEM": "0", "TOPO": "0", "SHARD_TOPO": "0",
+             "CONSOBS": "0"},
     )
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
     assert "jaxlint" in proc.stdout and "no regression" in proc.stdout
@@ -395,6 +406,8 @@ def test_lint_sh_chains_both_gates(tmp_path):
     script = (REPO / "tools" / "lint.sh").read_text()
     assert "blockchain_simulator_tpu.lint.graph" in script
     assert '"${GRAPH:-1}"' in script
+    assert "blockchain_simulator_tpu.lint.comms" in script
+    assert '"${COMMS:-1}"' in script
     assert "blockchain_simulator_tpu.serve --self-test" in script
     assert '"${SERVE:-1}"' in script
     assert "tools/chaos_drill.py --quick" in script

@@ -219,11 +219,11 @@ def test_audit_configs_hit_their_engine_arms():
 
 # ------------------------------------------------------------ budget gate
 
-def _report(name="p", flops=1000.0, nbytes=5000.0, budget=True):
+def _report(name="p", flops=1000.0, nbytes=5000.0, budget=True, memory=None):
     return audit.ProgramReport(
         program=name, factory="f", fingerprint="x" * 24,
-        cost={"flops": flops, "bytes": nbytes}, prims={}, n_eqns=1,
-        const_bytes=0, divergence_group=None, budget=budget,
+        cost={"flops": flops, "bytes": nbytes}, memory=memory, prims={},
+        n_eqns=1, const_bytes=0, divergence_group=None, budget=budget,
     )
 
 
@@ -260,6 +260,56 @@ def test_budget_missing_and_regression_and_stale():
     res = _result({"p": _report(budget=False)})
     audit.apply_budgets(res, {}, 0.25)
     assert res.findings == []
+
+
+def test_memory_budget_axes_gate_and_pin():
+    """The memory satellite: compiled memory_analysis axes (peak temp +
+    argument bytes) gate alongside flops/bytes and land in written
+    budgets."""
+    mem = {"temp_bytes": 4096.0, "argument_bytes": 2048.0}
+    pin = {"flops": 1000.0, "bytes": 5000.0,
+           "temp_bytes": 1024.0, "argument_bytes": 2048.0}
+
+    # temp allocation 4x over its pin: regression on the memory axis
+    res = _result({"p": _report(memory=dict(mem))})
+    audit.apply_budgets(res, {"p": pin}, tolerance=0.25)
+    assert [(f.rule, f.detail) for f in res.findings] == [
+        ("budget-regression", "temp_bytes")
+    ]
+
+    # at-pin memory is clean
+    res = _result({"p": _report(memory={"temp_bytes": 1024.0,
+                                        "argument_bytes": 2048.0})})
+    audit.apply_budgets(res, {"p": pin}, tolerance=0.25)
+    assert res.findings == [] and res.stale_budgets == []
+
+    # pinned memory axis with NO measurement is exit-2 material, not a
+    # silent pass (the backend stopped reporting memory_analysis)
+    res = _result({"p": _report(memory=None)})
+    audit.apply_budgets(res, {"p": pin}, tolerance=0.25)
+    assert res.findings == []
+    assert any("temp_bytes" in e for e in res.errors)
+
+
+def test_write_baseline_pins_memory_axes(tmp_path):
+    path = str(tmp_path / "GRAPH_BASELINE.json")
+    mem = {"temp_bytes": 4096.0, "argument_bytes": 2048.0}
+    audit.write_baseline(path, _result({"p": _report(memory=dict(mem))}))
+    doc = audit.load_baseline(path)
+    assert doc["budgets"]["p"] == {
+        "flops": 1000.0, "bytes": 5000.0,
+        "temp_bytes": 4096.0, "argument_bytes": 2048.0,
+    }
+
+
+def test_memory_summary_on_real_lowering(small_audit):
+    """ir.memory_summary returns both axes, positive, on a real compiled
+    budget program (the fixture audit compiles sim.pbft_tick)."""
+    res, _ = small_audit
+    rep = res.reports["sim.pbft_tick"]
+    assert rep.memory is not None
+    assert rep.memory["argument_bytes"] > 0
+    assert rep.memory["temp_bytes"] >= 0
 
 
 def test_budget_gate_fires_on_fattened_real_program(small_audit):
@@ -385,6 +435,12 @@ def test_committed_baseline_pins_every_budgeted_program():
     assert budgeted == set(doc["budgets"])
     for name, pin in doc["budgets"].items():
         assert pin["flops"] > 0 and pin["bytes"] > 0, name
+        # the memory satellite: the MEMORY_PINNED representatives carry
+        # compiled memory axes (temp may legitimately be 0 for tiny
+        # programs); the rest stay trace-only (compiles cost minutes)
+        if name in prog_mod.MEMORY_PINNED:
+            assert pin["argument_bytes"] > 0, name
+            assert pin["temp_bytes"] >= 0, name
     for entry in doc["entries"].values():
         assert entry["justification"] and \
             not entry["justification"].startswith("TODO")

@@ -148,8 +148,9 @@ def trajectory(rows: list[dict]) -> dict[str, list[dict]]:
 # the bench's own acceptance is its exit code — while the smoke-scale
 # "shard_topo_ticks_per_s" (lint.sh chain) gates by default.
 UNGATED_SUFFIXES = ("_findings", "_compile_s", "_p50_ms")
-UNGATED_PREFIXES = ("graph_", "chaos_", "fleet_", "journal_", "resume_",
-                    "telemetry_", "topo_", "shard_topo_full_", "consobs_")
+UNGATED_PREFIXES = ("graph_", "comms_", "chaos_", "fleet_", "journal_",
+                    "resume_", "telemetry_", "topo_", "shard_topo_full_",
+                    "consobs_")
 
 # Committed per-metric baselines: the first trajectory row of each listed
 # metric, pinned in-repo so a series without a second runs.jsonl sample

@@ -62,6 +62,23 @@ if [ "${GRAPH:-1}" != "0" ]; then
     fi
 fi
 
+# shardlint (lint/comms): every mesh-capable factory compiled under its
+# representative virtual-device meshes, post-SPMD collectives extracted
+# and gated against COMMS_BASELINE.json (counts + bytes-moved-per-device,
+# growth from a zero pin always fails).  COMMS=0 skips (~2.5 min of SPMD
+# compiles on this box); lands comms_new_findings + per-program
+# comms_*_bytes in runs.jsonl (charted, never gated by bench_compare —
+# the budget gate lives in lint.comms itself).
+if [ "${COMMS:-1}" != "0" ]; then
+    echo "== shardlint =="
+    python -m blockchain_simulator_tpu.lint.comms --format json
+    comms_rc=$?
+    if [ "$comms_rc" -ne 0 ]; then
+        echo "lint.sh: shardlint FAILED (rc=$comms_rc)" >&2
+        rc=1
+    fi
+fi
+
 # Serving smoke (serve/__main__.py --self-test): ephemeral daemon on the
 # CPU backend, a batch/reject/health drill over real HTTP, one JSON summary
 # line; lands serve_rps / serve_p99_ms in runs.jsonl when set (p99 is gated

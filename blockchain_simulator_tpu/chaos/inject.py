@@ -42,7 +42,12 @@ Registered sites (grep ``chaos_point(`` for ground truth):
   ``arm`` — ``primary``/``degrade``/``degrade-checkpoint`` — and
   ``mesh``), so a drill can kill a sweep between durable chunk appends
   (the resume drill) or wedge exactly the primary arm and watch the
-  supervisor degrade (parallel/journal.py).
+  supervisor degrade (parallel/journal.py);
+- ``query.step`` — query/engine.py, once per refinement generation
+  BEFORE its dispatch (ctx carries ``step``, ``n``, ``values``), so a
+  drill can kill an adaptive search between durable step appends and
+  pin the resume-with-0-recomputed-steps contract (the ``query-kill9``
+  scenario).
 """
 
 from __future__ import annotations

@@ -348,6 +348,96 @@ def check_sweep_journal(
     return violations
 
 
+def check_query_trail(result: dict, journal=None,
+                      expect_monotone: bool = True) -> list[str]:
+    """The adaptive-query accounting contracts (query/engine.py):
+
+    1. **Trail well-formed** — steps numbered consecutively from 0, one
+       verdict per probed value, and no value ever evaluated twice (the
+       memoization rule: a refinement loop that re-probes a value is
+       wasting dispatches or disagreeing with itself).
+    2. **Points complete** — the evaluation trail carries exactly one
+       metrics row per (probed value, seed).
+    3. **Answer consistent** — the reported boundary agrees with the
+       recorded verdicts: the surviving side really passed, the failing
+       side really failed, and a fully-narrowed bracket is exactly one
+       step wide.
+    4. **Key hygiene** — every chunk key ends in its step's ``+q<step>``
+       suffix (parallel/journal.query_key_suffix), so query chunks can
+       never collide with grid (pure hex) or probe (``+p``) chunks; with
+       ``journal`` given, every trail key is present and valid there
+       (run :func:`check_sweep_journal` separately for the journal-side
+       duplicate/checksum rules).
+    5. **Monotone** (``expect_monotone``) — the search observed no
+       verdict ordered against the monotone-predicate assumption
+       (KNOWN_ISSUES.md documents when to relax this).
+    """
+    violations: list[str] = []
+    trail = result.get("trail")
+    if not isinstance(trail, list) or not trail:
+        return [f"query trail missing/empty: {type(trail).__name__}"]
+    query = result.get("query") or {}
+    answer = result.get("answer") or {}
+    seeds = list(query.get("seeds") or [])
+    verdicts: dict[int, bool] = {}
+    for i, step in enumerate(trail):
+        if step.get("step") != i:
+            violations.append(
+                f"trail step {i} numbered {step.get('step')!r}")
+        values = step.get("values") or []
+        sv = step.get("verdicts") or []
+        if sorted(v for v, _ in sv) != sorted(values):
+            violations.append(
+                f"step {i} verdicts {sv} do not cover values {values}")
+        for v, ok in sv:
+            if v in verdicts:
+                violations.append(
+                    f"value {v} evaluated twice (step {i} re-probed it)")
+            verdicts[int(v)] = bool(ok)
+        sfx = f"+q{i}"
+        for key in step.get("keys") or []:
+            if not str(key).endswith(sfx):
+                violations.append(
+                    f"step {i} chunk key {key!r} lacks the {sfx!r} suffix")
+            elif journal is not None \
+                    and str(key) not in journal.completed():
+                violations.append(
+                    f"step {i} chunk {key!r} missing/invalid in journal")
+    points = result.get("points")
+    if points is not None:
+        want = {(v, s) for v in verdicts for s in seeds}
+        got = [(p.get("value"), p.get("seed")) for p in points]
+        if len(got) != len(set(got)) or set(got) != want:
+            violations.append(
+                f"points cover {len(set(got))}/{len(got)} unique "
+                f"(value, seed) pairs, expected exactly {len(want)}")
+    low_keys = {"max_f_surviving": ("f_max", "first_failing"),
+                "cliff_locate": ("last_true", "first_false"),
+                "min_k_finality": ("last_failing", "k_min")}
+    kind = query.get("kind")
+    lo_k, hi_k = low_keys.get(kind, (None, None))
+    if lo_k is not None:
+        low, high = answer.get(lo_k), answer.get(hi_k)
+        ok_low = kind != "min_k_finality"  # low side passes except min_k
+        if low is not None and verdicts.get(low) is not ok_low:
+            violations.append(
+                f"answer {lo_k}={low} contradicts its verdict "
+                f"{verdicts.get(low)}")
+        if high is not None and verdicts.get(high) is ok_low:
+            violations.append(
+                f"answer {hi_k}={high} contradicts its verdict "
+                f"{verdicts.get(high)}")
+        if low is not None and high is not None and high != low + 1:
+            violations.append(
+                f"bracket not fully narrowed: {lo_k}={low}, {hi_k}={high}")
+    run = result.get("run") or {}
+    if expect_monotone and run.get("monotonicity_violations", 0):
+        violations.append(
+            f"{run['monotonicity_violations']} monotonicity violation(s) "
+            f"observed during the search")
+    return violations
+
+
 def check_server(
     ledger: Ledger | None,
     stats: dict,

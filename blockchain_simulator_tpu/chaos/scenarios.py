@@ -38,7 +38,12 @@ Scenario catalog (tools/chaos_drill.py runs all; tests pick):
   (the subprocess SIGKILL variant is tools/sweep_resume_drill.py);
 - ``sweep-wedge``     a chunk's dispatch wedges → the supervisor's
   deadline fires, bounded retries, then the recorded degrade arm
-  answers — the journal carries the whole transition trail.
+  answers — the journal carries the whole transition trail;
+- ``query-kill9``     a replica dies mid-adaptive-search (query/) with
+  the admission WAL-durable and two generations journaled → a restart
+  replays the query, serves every completed generation from the journal
+  (0 recomputed steps) and re-answers bit-equal to an uninterrupted
+  reference.
 
 All scenarios run at toy scale (pbft n=8, exact sampler — the shared
 tests/test_zserve.py template) so the whole drill is compile-cheap and
@@ -595,6 +600,139 @@ def scenario_sweep_wedge(ctl, workdir, quick):
             "extra": {"events": events, "rows_bit_equal": rows_equal}}
 
 
+def scenario_query_kill9(ctl, workdir, quick):
+    """The durable-query crash drill, in-process: a replica dies
+    (ChaosKill at the ``query.step`` point, the worker's stand-in for
+    process death) two refinement generations into an adaptive search,
+    with the admission WAL-durable and both generations journaled.  A
+    restarted replica on the same WAL + journal replays the query: every
+    completed generation is served from the journal (0 recomputed steps —
+    their chunk keys stay unique), 0 new executables compile (the search
+    executable was warm), and the final answer is bit-equal to an
+    uninterrupted reference run of the same query.  A third restart
+    replays nothing."""
+    from blockchain_simulator_tpu.parallel import journal as journal_mod
+    from blockchain_simulator_tpu.query import engine as qengine
+    from blockchain_simulator_tpu.query import spec as qspec
+    from blockchain_simulator_tpu.serve import ScenarioServer
+    from blockchain_simulator_tpu.utils.config import SimConfig
+
+    wal = os.path.join(workdir, "query_wal.jsonl")
+    jp = os.path.join(workdir, "query.journal")
+    # sim_ms=400: long enough that pbft n=8 commits below the cliff, so
+    # the search takes 3 generations (endpoints, midpoints, final) — the
+    # kill lands on generation 2 with 0 and 1 already durable
+    qspec_obj = {"kind": "max_f_surviving", "seeds": [0, 1]}
+    qobj = dict(TPL, sim_ms=400, id="q-kill", timeout_s=300.0,
+                query=qspec_obj)
+    kill_step = 2
+    ctl.fail_next("query.step", n=1, exc=inject.ChaosKill,
+                  match=lambda c: c.get("step") == kill_step)
+    violations = []
+    # phase 1: the worker dies mid-search; the server is abandoned
+    # (never closed) — the in-process process-death stand-in
+    crashed = ScenarioServer(wal_path=wal, journal_path=jp)
+    crashed.submit(qobj)
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 120:
+        with crashed._lock:
+            workers = [t for _, _, t in crashed._queries]
+        if workers and not any(t.is_alive() for t in workers):
+            break
+        time.sleep(0.02)
+    else:
+        violations.append("query worker never died under the chaos kill")
+    crashed._wal.close()  # the admit is fsynced; drop the handles
+    crashed._journal.close()
+    del crashed
+    pre_keys = set(journal_mod.SweepJournal(jp).completed())
+    if len(pre_keys) != kill_step:
+        violations.append(
+            f"{len(pre_keys)} generations survived the kill, want "
+            f"{kill_step}")
+    # phase 2: restart on the same WAL + journal — the replay re-runs
+    # the query, resuming from the journal
+    misses_before = aotcache.registry.stats()["misses"]
+    srv2 = ScenarioServer(wal_path=wal, journal_path=jp)
+    t0 = time.monotonic()
+    # the query leaves the arrivals queue the moment its worker spawns,
+    # so quiescence is "the replayed request answered", not queue depth
+    while not srv2.stats()["served"] and time.monotonic() - t0 < 120:
+        time.sleep(0.02)
+    stats = srv2.stats()
+    srv2.close()
+    resume_misses = aotcache.registry.stats()["misses"] - misses_before
+    if resume_misses != 0:
+        violations.append(
+            f"resume compiled {resume_misses} executables (want 0: the "
+            f"search executable was warm)")
+    if stats["replayed"] != 1:
+        violations.append(f"replayed {stats['replayed']} != 1 pending")
+    log = os.environ.get(obs.RUNS_ENV)
+    recs = obs.read_jsonl(log) if log else []
+    rec = next((r for r in recs if r.get("id") == "q-kill"
+                and r.get("replayed") is True), None)
+    cached_steps = None
+    answer_equal = False
+    if rec is None or rec.get("status") != "ok":
+        violations.append(
+            f"replayed query missing or failed: "
+            f"{None if rec is None else rec.get('kind')}")
+    else:
+        run = rec.get("run") or {}
+        cached_steps = run.get("cached_steps")
+        # 0 completed steps recomputed: every pre-kill generation served
+        # from the journal, only the missing ones dispatched
+        if cached_steps != kill_step:
+            violations.append(
+                f"resume served {cached_steps} generations from the "
+                f"journal, want {kill_step}")
+        if run.get("dispatches") != run.get("steps", 0) - kill_step:
+            violations.append(
+                f"resume dispatched {run.get('dispatches')} generations, "
+                f"want {run.get('steps', 0) - kill_step} "
+                f"(recompute-at-most-zero broken)")
+        # bit-equality vs an uninterrupted reference run of the query —
+        # journaled (to a fresh journal) so the trail's chunk keys are
+        # populated on both sides; the keys are content-derived, so they
+        # match across journal files by construction
+        cfg = SimConfig(**dict(TPL, sim_ms=400))
+        ref = qengine.run_query(
+            cfg, qspec.parse_query(qspec_obj),
+            journal=journal_mod.SweepJournal(
+                os.path.join(workdir, "query_ref.journal")))
+        answer_equal = (
+            obs.canonical_json(rec.get("answer"))
+            == obs.canonical_json(ref["answer"])
+            and obs.canonical_json(rec.get("trail"))
+            == obs.canonical_json(ref["trail"])
+        )
+        if not answer_equal:
+            violations.append(
+                "replayed answer/trail diverge from the uninterrupted "
+                "reference query")
+        post = journal_mod.SweepJournal(jp)
+        violations += invariants.check_query_trail(rec, journal=post)
+        violations += invariants.check_sweep_journal(post)
+    # phase 3: idempotence — nothing left to replay
+    srv3 = ScenarioServer(wal_path=wal, journal_path=jp)
+    replay_again = srv3.stats()["replayed"]
+    srv3.close()
+    if replay_again != 0:
+        violations.append(
+            f"third restart replayed {replay_again} ids (want 0)")
+    return {"ledger": None, "stats": stats, "violations": violations,
+            "replayed_ids": ["q-kill"],
+            # the crashed server died holding this admission: the
+            # telemetry conservation balance must be off by exactly one
+            "lost_admissions": 1,
+            "extra": {"generations_before_kill": len(pre_keys),
+                      "cached_steps_on_resume": cached_steps,
+                      "resume_misses": resume_misses,
+                      "answer_bit_equal": answer_equal,
+                      "replay_again": replay_again}}
+
+
 SCENARIOS = {
     "dispatch-fail": scenario_dispatch_fail,
     "dispatch-hang": scenario_dispatch_hang,
@@ -606,6 +744,7 @@ SCENARIOS = {
     "crash-restart": scenario_crash_restart,
     "sweep-kill9": scenario_sweep_kill9,
     "sweep-wedge": scenario_sweep_wedge,
+    "query-kill9": scenario_query_kill9,
 }
 
 

@@ -101,6 +101,28 @@ def chunk_key(canon, index: int, points, mesh=None,
     return hashlib.sha256(_canonical_json(ident).encode()).hexdigest()[:16]
 
 
+def query_key_suffix(step: int) -> str:
+    """The query-engine chunk-key namespace (query/engine.py): every
+    refinement step's chunk journals under ``chunk_key(...) + "+q<step>"``
+    — mirroring the obsim probe suffix (``+p<W>``, sweep.run_dyn_points)
+    so an adaptive search and a grid sweep over the SAME canonical
+    structure can share one journal file without ever sharing a key.
+    Grid keys are pure 16-hex; probe keys end ``+p...``; query keys end
+    ``+q<step>`` — three disjoint namespaces by construction."""
+    return f"+q{int(step)}"
+
+
+def query_chunk_key(canon, step: int, points, mesh=None,
+                    n_out: int | None = None) -> str:
+    """Content key of ONE query refinement chunk: the ordinary
+    :func:`chunk_key` at index 0 (each refinement generation dispatches
+    as one chunk) plus the ``+q<step>`` namespace suffix.  Derived from
+    the search trajectory alone — a drill's coverage check recomputes
+    these without reading the journal (the dyn_chunk_keys idiom)."""
+    return chunk_key(canon, 0, points, mesh, n_out=n_out) \
+        + query_key_suffix(step)
+
+
 class SweepJournal:
     """Append-only chunk-result journal; one JSON object per line.
 

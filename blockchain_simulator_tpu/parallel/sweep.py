@@ -438,6 +438,13 @@ def _dispatch_dyn_points(canon: SimConfig, points, record: bool = True,
     inject.chaos_point("sweep.dyn_dispatch", canon=canon, n=len(points))
     if probe is not None:
         from blockchain_simulator_tpu.obsim import build as obsim_build
+    if mesh is not None and partition.mesh_size(mesh) > 1 \
+            and len(points) == 1:
+        # a 1-point list on the mesh path would pad to a full sweep-axis
+        # width of duplicate lanes; the single-device program answers it
+        # with zero pad waste and rows bit-equal under the exact sampler
+        # (the same equivalence the supervised degrade arm relies on)
+        mesh = None
     dispatch_points = points
     if mesh is not None and partition.mesh_size(mesh) > 1:
         lanes = max(partition.sweep_axis_size(mesh), 1)
@@ -551,7 +558,8 @@ def _run_chunk(canon, tile, record, n_out, mesh, supervise, journal, key,
 def run_dyn_points(canon: SimConfig, points, record: bool = True,
                    n_out: int | None = None, mesh=None, journal=None,
                    chunk_size: int | None = None, supervise=None,
-                   multi_seed: bool = False, probe=None):
+                   multi_seed: bool = False, probe=None,
+                   key_suffix: str = "", with_index: bool = False):
     """THE group-dispatch primitive: one vmapped executable over an
     arbitrary list of same-structure ``(cfg, seed)`` points.
 
@@ -616,13 +624,50 @@ def run_dyn_points(canon: SimConfig, points, record: bool = True,
     flushes journal under a probe-suffixed chunk key, so a journal
     written disarmed never answers an armed flush (and vice versa);
     journal-cached armed rows serve their stored summaries as-written
-    without re-firing the violation hook."""
+    without re-firing the violation hook.
+
+    ``key_suffix`` is appended verbatim to every chunk's journal key
+    (after the probe suffix) — the namespace hook the query engine
+    (query/engine.py) uses to keep refinement chunks (``+q<step>``)
+    disjoint from grid chunks over the same canonical structure.
+
+    ``with_index=True`` returns ``(rows, meta)`` instead of bare rows:
+    ``meta["rows"][i]`` maps output row ``i`` back to its point —
+    ``{"point": index into ``points``, "seed", "key" (journal chunk key
+    or None un-journaled), "cached" (served from the journal without
+    dispatching)}`` — and ``meta`` carries the dispatch accounting a
+    refinement loop needs (``dispatches`` actually fired, ``lanes``
+    dispatched including mesh padding, ``pad`` wasted lanes,
+    ``chunks`` per-chunk trail).  A 1-point list never pads: it takes
+    the single-device path even under a mesh (bit-equal, exact
+    sampler)."""
     points = list(points)
+    meta = {"rows": [], "chunks": [], "lanes": 0, "dispatches": 0, "pad": 0}
+
+    def _lanes(n: int) -> int:
+        if n > 1 and mesh is not None and partition.mesh_size(mesh) > 1:
+            axis = max(partition.sweep_axis_size(mesh), 1)
+            return -(-n // axis) * axis
+        return n
+
+    def _done(rows):
+        return (rows, meta) if with_index else rows
+
     if journal is None and supervise is None:
-        return _dispatch_dyn_points(canon, points, record, n_out, mesh,
+        rows = _dispatch_dyn_points(canon, points, record, n_out, mesh,
                                     multi_seed, probe)
+        if points:
+            meta["dispatches"] = 1
+            meta["lanes"] = _lanes(len(points))
+            meta["pad"] = meta["lanes"] - len(points)
+        pts_out = points if n_out is None else points[:n_out]
+        meta["rows"] = [
+            {"point": i, "seed": int(s), "key": None, "cached": False}
+            for i, (_, s) in enumerate(pts_out)
+        ]
+        return _done(rows)
     if not points:
-        return []
+        return _done([])
     if chunk_size is None or n_out is not None:
         # n_out callers (serve's bucket-padded flushes) journal the whole
         # batch as ONE chunk: pad lanes never split across chunk keys
@@ -642,8 +687,16 @@ def run_dyn_points(canon: SimConfig, points, record: bool = True,
             # armed and disarmed flushes must never share a journal key:
             # a cached disarmed chunk has no "probe" summaries to serve
             key += f"+p{probe.windows}{'m' if probe.monitors else ''}"
+        key += key_suffix
         cached = done.get(key)
         if cached is not None and len(cached) == want:
+            meta["chunks"].append({"key": key, "index": index,
+                                   "cached": True, "n": want})
+            meta["rows"] += [
+                {"point": start + j, "seed": int(tile[j][1]), "key": key,
+                 "cached": True}
+                for j in range(want)
+            ]
             out.extend(cached)
             continue
         # every dispatch ATTEMPT runs record=False: only the winning
@@ -660,8 +713,18 @@ def run_dyn_points(canon: SimConfig, points, record: bool = True,
             pts_out = tile if t_out is None else tile[:t_out]
             for (cfg_i, seed_i), m in zip(pts_out, rows):
                 obs.record_run({"seed": int(seed_i), **m}, cfg_i)
+        meta["dispatches"] += 1
+        meta["lanes"] += _lanes(len(tile))
+        meta["pad"] += _lanes(len(tile)) - len(tile)
+        meta["chunks"].append({"key": key, "index": index,
+                               "cached": False, "n": len(rows)})
+        meta["rows"] += [
+            {"point": start + j, "seed": int(tile[j][1]), "key": key,
+             "cached": False}
+            for j in range(len(rows))
+        ]
         out.extend(rows)
-    return out
+    return _done(out)
 
 
 def dyn_chunk_keys(cfg: SimConfig, fault_configs, seeds, mesh=None):

@@ -1,0 +1,10 @@
+"""Adaptive consensus-design queries (ROADMAP item 5): typed threshold
+searches (query/spec.py) answered by a deterministic bisection/
+refinement engine (query/engine.py) over the compile-once sweep stack —
+journaled for kill -9 resume, served as durable long-running requests
+(serve/schema.py ``"query"``)."""
+
+from blockchain_simulator_tpu.query.engine import run_query
+from blockchain_simulator_tpu.query.spec import QuerySpec, parse_query
+
+__all__ = ["QuerySpec", "parse_query", "run_query"]

@@ -374,7 +374,12 @@ class FleetRouter:
             self._threads.append(t)
             self._threads = [x for x in self._threads if x.is_alive()]
         t.start()
-        if self.hedge_ms > 0:
+        # query requests are never hedged: an adaptive search is minutes
+        # long by design, so a silent-past-hedge_ms duplicate would run
+        # the WHOLE search twice on another replica — slow-replica rescue
+        # for queries is the WAL handoff path, not the hedge
+        is_query = isinstance(obj, dict) and obj.get("query") is not None
+        if self.hedge_ms > 0 and not is_query:
             timer = threading.Timer(
                 self.hedge_ms / 1000.0, self._hedge,
                 args=(dict(obj), req_id, group, pending),

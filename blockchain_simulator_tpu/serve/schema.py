@@ -36,7 +36,7 @@ from blockchain_simulator_tpu.models.base import canonical_fault_cfg
 from blockchain_simulator_tpu.utils.config import FaultConfig, SimConfig
 
 # Request-level keys that are not SimConfig fields.
-REQUEST_KEYS = ("id", "seed", "timeout_s", "probe")
+REQUEST_KEYS = ("id", "seed", "timeout_s", "probe", "query")
 
 # SimConfig fields a request may set.  mesh_axis is excluded: the serving
 # dispatch is single-device vmap (sharded serving is ROADMAP item 2).
@@ -196,12 +196,22 @@ class ScenarioRequest:
     # consobs-* registry entries (obsim/build.py), so arming one request
     # can never change another's program
     probe: object = None
+    # adaptive-query opt-in (query/spec.QuerySpec, None = ordinary
+    # scenario): the request's cfg becomes the BASE config of a threshold
+    # search (query/engine.py) instead of one sim — a long-running request
+    # the batcher diverts to its own worker (serve/server.py), journaled
+    # per refinement step and WAL-durable like any other admission
+    query: object = None
     # -- telemetry (utils/telemetry.py; host-side only) --------------------
     # trace identity: minted at admission (or adopted from the router's
     # X-Blocksim-Trace header, in which case parent_span is the router's
     # send-span id), so the replica's span tree hangs off the fleet's
     trace_id: str | None = None
     parent_span: str | None = None
+    # pre-minted root span id: the query worker mints it BEFORE the search
+    # so each query.step span can parent under the serve.request root the
+    # server only emits at answer time (None = let emit() mint one)
+    root_span: str | None = None
     t_admit: float = 0.0
     # lifecycle stamps (time.monotonic), filled as the request moves
     # batcher-side; the server synthesizes the segment spans (queue_wait /
@@ -242,6 +252,13 @@ def parse_request(obj, req_id: str, default_timeout_s: float = 30.0,
         raise InvalidRequestError(
             "probe must be true/false or a JSON object of ProbeConfig "
             f"fields, got {type(probe_kw).__name__}"
+        )
+
+    query_kw = obj.pop("query", None)
+    if query_kw is not None and not isinstance(query_kw, dict):
+        raise InvalidRequestError(
+            "query must be a JSON object of QuerySpec fields, got "
+            f"{type(query_kw).__name__}"
         )
 
     fault_kw = obj.pop("faults", None)
@@ -304,6 +321,23 @@ def parse_request(obj, req_id: str, default_timeout_s: float = 30.0,
         except (TypeError, ValueError, KeyError) as e:
             raise InvalidRequestError(f"probe: {e}") from e
 
+    query = None
+    if query_kw is not None:
+        from blockchain_simulator_tpu.query import spec as query_spec
+
+        if probe is not None:
+            raise InvalidRequestError(
+                "query requests do not accept probe (arm the probe on "
+                "ordinary scenario requests)")
+        try:
+            query = query_spec.parse_query(query_kw)
+            # resolve the domain against THIS base config now: an empty
+            # or out-of-range domain is a 400 at admission, never a
+            # worker-thread surprise
+            query_spec.resolve_domain(query, cfg)
+        except ValueError as e:
+            raise InvalidRequestError(f"query: {e}") from e
+
     return ScenarioRequest(
         req_id=req_id,
         cfg=cfg,
@@ -311,6 +345,7 @@ def parse_request(obj, req_id: str, default_timeout_s: float = 30.0,
         seed=seed,
         timeout_s=timeout_s,
         probe=probe,
+        query=query,
     )
 
 

@@ -229,6 +229,7 @@ class ScenarioServer:
             "received": 0, "served": 0, "timeouts": 0, "batches": 0,
             "degraded_batches": 0, "rejected": {}, "errors": 0,
             "replayed": 0, "quarantined": 0, "batcher_restarts": 0,
+            "queries": 0,
         }
         # PRIVATE latency histograms (utils/telemetry.py) behind the
         # /stats "latency_ms" percentiles: per-server so N servers in one
@@ -247,6 +248,10 @@ class ScenarioServer:
         # a supervised restart resumes exactly the groups the dead thread
         # left behind (the chaos batcher-kill drill pins this)
         self._pending: dict = {}  # group key -> list[(req, PendingResponse)]
+        # long-running query requests (schema "query"): each runs on its
+        # own worker thread outside the micro-batching loop — tracked so
+        # close() can wait for them and sweep any dead worker's future
+        self._queries: list = []  # [(req, PendingResponse, Thread)]
         self._backoff = self.restart_backoff_s
         self._closing = False
         self._drain = True
@@ -310,6 +315,17 @@ class ScenarioServer:
         if self._thread is not None and self._thread.is_alive():
             self._thread.join()
         self._thread = None
+        # query workers answer through their own threads: wait for them,
+        # so the sweep below only 503s a genuinely dead worker's future
+        # (a ChaosKill'd search) — never a result that was seconds away
+        with self._lock:
+            queries = list(self._queries)
+            self._queries = []
+        for _, _, t in queries:
+            if t.is_alive():
+                t.join()
+        self._reject_shutdown(
+            [(req, fut) for req, fut, _ in queries if not fut.done()])
         # the sweep: whatever the batcher could not (or was told not to)
         # serve gets its typed 503 + rejection manifest right here — the
         # invariant checker's "no request unaccounted" has no exceptions
@@ -558,16 +574,25 @@ class ScenarioServer:
                 else:
                     req, fut = item
                     req.t_drained = time.monotonic()
-                    if req.req_id in self._quarantine:
-                        key = (_QUARANTINE_GROUP, req.req_id)
+                    if req.query is not None:
+                        # adaptive queries are long-running requests: a
+                        # search's refinement generations must not block
+                        # the micro-batching loop, so each gets its own
+                        # worker thread (it answers through _answer like
+                        # every batched request)
+                        self._spawn_query(req, fut)
                     else:
-                        # probe config is part of the group identity:
-                        # armed and disarmed requests never share a flush
-                        # (one executable per (structure, probe config);
-                        # dispatch assumes probe-homogeneous batches)
-                        key = req.canon if req.probe is None \
-                            else (req.canon, req.probe)
-                    pending.setdefault(key, []).append((req, fut))
+                        if req.req_id in self._quarantine:
+                            key = (_QUARANTINE_GROUP, req.req_id)
+                        else:
+                            # probe config is part of the group identity:
+                            # armed and disarmed requests never share a
+                            # flush (one executable per (structure, probe
+                            # config); dispatch assumes probe-homogeneous
+                            # batches)
+                            key = req.canon if req.probe is None \
+                                else (req.canon, req.probe)
+                        pending.setdefault(key, []).append((req, fut))
                 try:
                     item = self._arrivals.get_nowait()
                 except queue.Empty:
@@ -669,10 +694,14 @@ class ScenarioServer:
         tid = req.trace_id or telemetry.new_trace_id()
         t0 = req.t_admit or req.submitted or t_ans
         status = "ok" if resp.get("status") == "ok" else "error"
+        # query workers pre-mint root_span BEFORE the search so each
+        # query.step span (emitted mid-search) already parents under the
+        # root this emit closes; ordinary requests let emit() mint it
         root = telemetry.emit(
             "serve.request", t0, t_ans, trace=tid, parent=req.parent_span,
-            status=status, id=req.req_id, outcome=counter,
-            replayed=req.replayed or None, replica=self.replica,
+            span_id=req.root_span, status=status, id=req.req_id,
+            outcome=counter, replayed=req.replayed or None,
+            replica=self.replica,
         )
         # ONE segment table drives both the span emits and the latency
         # histograms (private /stats percentiles + the process-global
@@ -810,6 +839,76 @@ class ScenarioServer:
                         pass
             counter = "served" if resp.get("status") == "ok" else "errors"
             self._answer(req, fut, resp, counter)
+
+    # --------------------------------------------------------------- queries
+    def _spawn_query(self, req, fut) -> None:
+        """Divert one admitted query request (schema ``"query"``) to its
+        own worker thread — already past admission and WAL-durable, so the
+        only fast-shutdown concern is a not-yet-started search (typed 503
+        here; a RUNNING search is joined by close())."""
+        with self._lock:
+            self._stats["queries"] += 1
+            closing, drain = self._closing, self._drain
+        if closing and not drain:
+            err = schema.ShuttingDownError(
+                "server shut down before this query was started")
+            self._answer(req, fut, err.to_response(req.req_id),
+                         schema.ShuttingDownError.kind)
+            return
+        t = threading.Thread(
+            target=self._run_query_worker, args=(req, fut),
+            name=f"query-{req.req_id}", daemon=True,
+        )
+        with self._lock:
+            self._queries.append((req, fut, t))
+        t.start()
+
+    def _run_query_worker(self, req, fut) -> None:
+        """One query request's whole lifetime: pre-mint the request root
+        span so every ``query.step`` span the engine emits parents under
+        the ``serve.request`` root the server only synthesizes at answer
+        time, run the deterministic search (journaled when the server has
+        a sweep journal — a WAL replay after a crash then serves every
+        completed generation from the journal, recomputing none), and
+        answer through the one terminal door.  An injected ChaosKill
+        escapes WITHOUT answering — the drill stand-in for the replica
+        dying mid-search with the admission durable in the WAL (the
+        handoff/restart replay re-runs the query)."""
+        from blockchain_simulator_tpu.query import engine as query_engine
+
+        now = time.monotonic()
+        if req.expired(now):
+            err = schema.RequestTimeoutError(
+                f"timed out after {req.timeout_s:.3f}s in queue")
+            self._answer(req, fut, err.to_response(req.req_id), "timeouts")
+            return
+        req.t_flush = req.t_dispatch0 = now
+        req.root_span = telemetry.new_span_id()
+        ctx = telemetry.TraceContext(
+            req.trace_id or telemetry.new_trace_id(), req.root_span)
+        req.trace_id = ctx.trace_id
+        try:
+            with telemetry.context(ctx):
+                result = query_engine.run_query(
+                    req.cfg, req.query, journal=self._journal)
+        except inject.ChaosKill:
+            return  # simulated replica death: unanswered, WAL-pending
+        except Exception as e:
+            req.t_dispatch1 = time.monotonic()
+            err = schema.DispatchFailedError(
+                f"query failed: {type(e).__name__}: {e}")
+            self._answer(req, fut, err.to_response(req.req_id), "errors")
+            return
+        req.t_dispatch1 = time.monotonic()
+        # the response carries the answer + the (small) step trail and
+        # run accounting; the per-point metrics rows stay in the journal
+        # — a response must stay queue-sized, not grid-sized
+        resp = {
+            "id": req.req_id, "status": "ok",
+            "query": result["query"], "answer": result["answer"],
+            "trail": result["trail"], "run": result["run"],
+        }
+        self._answer(req, fut, resp, "served")
 
     # ---------------------------------------------------------------- stats
     def stats(self) -> dict:

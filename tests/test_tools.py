@@ -263,6 +263,24 @@ def test_bench_compare_never_gates_telemetry_series(tmp_path):
     assert "telemetry_coverage_pct" in proc.stdout
 
 
+def test_bench_compare_never_gates_query_series(tmp_path):
+    """The adaptive-query drill series (query_ prefix, tools/
+    query_drill.py) is charted only: violations are lower-is-better and
+    the savings multiplier mixes domain widths across runs — both are
+    gated by the drill's own exit code, never the throughput rule."""
+    runs = tmp_path / "runs.jsonl"
+    rows = []
+    for metric, vals in (("query_invariant_violations", (2, 0)),
+                         ("query_dispatch_savings_x", (21.3, 1.3))):
+        rows += [{"metric": metric, "value": v,
+                  "manifest": {"obs_schema": 1}} for v in vals]
+    runs.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    proc = _run([str(BENCH_COMPARE), _bench_artifact(tmp_path, 1, 100.0),
+                 "--runs", str(runs)])
+    assert proc.returncode == 0, proc.stdout
+    assert "query_dispatch_savings_x" in proc.stdout
+
+
 def test_bench_compare_gates_p99_latency_inverted(tmp_path):
     """serve_p99_ms is lower-is-better AND gated: an increase beyond the
     threshold is the regression; a decrease (faster serving) never trips."""

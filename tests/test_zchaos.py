@@ -327,6 +327,16 @@ def test_scenario_sweep_kill9_resumes_without_recompute():
     assert rep["chaos_schedule"] == ["sweep.chunk:fail"]
 
 
+def test_scenario_query_kill9_resumes_without_recompute():
+    rep = _run_clean("query-kill9")
+    assert rep["generations_before_kill"] == 2
+    assert rep["cached_steps_on_resume"] == 2
+    assert rep["resume_misses"] == 0
+    assert rep["answer_bit_equal"] is True
+    assert rep["replay_again"] == 0
+    assert rep["chaos_schedule"] == ["query.step:fail"]
+
+
 def test_scenario_sweep_wedge_takes_degrade_path():
     rep = _run_clean("sweep-wedge")
     assert rep["events"] == ["deadline", "retry", "deadline", "degrade"]

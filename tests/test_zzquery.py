@@ -1,0 +1,349 @@
+"""Adaptive consensus-design queries (query/): spec validation, the
+bisection engine against a dense reference, executable-registry pinning,
+journal key hygiene, durable replay, and the serve-path integration.
+
+Late-alphabet file on purpose: the compile-heavy tests run after the
+registry is warm from the earlier suites.  Quick scale here is pbft n=8
+``sim_ms=400`` — at the 200 ms of the shared serve template pbft commits
+NOTHING, so every fault predicate is false and there is no cliff to
+find; 400 ms commits 4 blocks below the cliff (n_crashed <= 1) and the
+boundary sits at n_crashed=2.  Tests that count compiles use a unique
+``sim_ms`` so their canonical structure is cold in the process registry.
+"""
+
+import json
+
+import pytest
+
+from blockchain_simulator_tpu.chaos import invariants
+from blockchain_simulator_tpu.models.base import canonical_fault_cfg
+from blockchain_simulator_tpu.parallel import journal as journal_mod
+from blockchain_simulator_tpu.parallel import sweep
+from blockchain_simulator_tpu.query import parse_query, run_query
+from blockchain_simulator_tpu.query import spec as qspec
+from blockchain_simulator_tpu.serve import InvalidRequestError, parse_request
+from blockchain_simulator_tpu.utils import aotcache, obs, telemetry
+from blockchain_simulator_tpu.utils.config import SimConfig
+
+QTPL = {"protocol": "pbft", "n": 8, "sim_ms": 400, "stat_sampler": "exact"}
+CFG = SimConfig(**QTPL)
+Q_MAXF = {"kind": "max_f_surviving", "seeds": [0, 1]}
+
+
+# ---------------------------------------------------------------- spec ------
+
+def test_parse_query_defaults_and_roundtrip():
+    s = parse_query(dict(Q_MAXF))
+    assert s.kind == "max_f_surviving"
+    assert s.param == "n_crashed"
+    assert s.seeds == (0, 1)
+    assert s.lo == 0 and s.hi == -1          # -1 = domain ceiling (n-1)
+    assert s.agg == "all_commit"
+    assert s.probe_width == 1
+    # to_dict round-trips through parse_query unchanged
+    assert parse_query(s.to_dict()) == s
+
+
+def test_parse_query_min_k_forces_degree_param():
+    s = parse_query({"kind": "min_k_finality", "seeds": [3]})
+    assert s.param == "degree"
+    # the fault kinds only search fault counts
+    with pytest.raises(ValueError, match="param"):
+        parse_query({"kind": "max_f_surviving", "param": "degree"})
+
+
+@pytest.mark.parametrize("bad", [
+    {"kind": "nope"},
+    {"kind": "cliff_locate", "param": "drop_prob"},
+    {"kind": "max_f_surviving", "seeds": []},
+    {"kind": "max_f_surviving", "seeds": [0.5]},
+    {"kind": "max_f_surviving", "seeds": [True]},
+    {"kind": "max_f_surviving", "lo": -1},
+    {"kind": "max_f_surviving", "lo": 5, "hi": 3},
+    {"kind": "max_f_surviving", "commit_target": 0},
+    {"kind": "max_f_surviving", "tick_budget": -1},
+    {"kind": "max_f_surviving", "probe_width": 0},
+    {"kind": "max_f_surviving", "probe_width": 65},
+    {"kind": "max_f_surviving", "agg": "median"},
+    {"kind": "max_f_surviving", "unknown_field": 1},
+])
+def test_parse_query_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_query(bad)
+
+
+def test_resolve_domain_defaults_and_ceilings():
+    lo, hi = qspec.resolve_domain(parse_query(Q_MAXF), CFG)
+    assert (lo, hi) == (0, CFG.n - 1)
+    # degree domains clamp lo to 1 (a 0-regular overlay is no overlay)
+    lo, hi = qspec.resolve_domain(
+        parse_query({"kind": "min_k_finality", "seeds": [0]}), CFG)
+    assert (lo, hi) == (1, CFG.n - 1)
+    with pytest.raises(ValueError, match="ceiling"):
+        qspec.resolve_domain(
+            parse_query(dict(Q_MAXF, hi=CFG.n)), CFG)
+
+
+def test_point_cfg_moves_only_the_searched_field():
+    import dataclasses
+    base = dataclasses.replace(CFG, faults=dataclasses.replace(
+        CFG.faults, n_byzantine=1))
+    moved = qspec.point_cfg(base, parse_query(Q_MAXF), 3)
+    assert moved.faults.n_crashed == 3
+    assert moved.faults.n_byzantine == 1      # the rest of the load stays
+    assert moved.protocol == base.protocol
+    k = qspec.point_cfg(CFG, parse_query(
+        {"kind": "min_k_finality", "seeds": [0]}), 4)
+    assert k.topology == "kregular" and k.degree == 4
+
+
+def test_row_ok_and_verdict_aggregation():
+    spec_all = parse_query(dict(Q_MAXF, commit_target=2, tick_budget=100))
+    good = {"blocks_final_all_nodes": 3, "agreement_ok": 1,
+            "last_commit_ms": 50.0}
+    late = dict(good, last_commit_ms=150.0)
+    none = {"blocks_final_all_nodes": 0, "agreement_ok": 1,
+            "last_commit_ms": -1.0}
+    assert qspec.row_ok("pbft", good, spec_all)
+    assert not qspec.row_ok("pbft", late, spec_all)   # past the budget
+    assert not qspec.row_ok("pbft", none, spec_all)   # never committed
+    assert not qspec.verdict("pbft", [good, late], spec_all)
+    maj = parse_query(dict(Q_MAXF, commit_target=2, tick_budget=100,
+                           agg="majority_commit", seeds=[0, 1, 2]))
+    assert qspec.verdict("pbft", [good, good, late], maj)
+    assert not qspec.verdict("pbft", [good, late, late], maj)
+
+
+# -------------------------------------------------------------- engine ------
+
+def _dense_boundary(spec):
+    """The dense-grid reference: every domain value evaluated, boundary
+    read off the verdict vector — what the engine must reproduce."""
+    lo, hi = qspec.resolve_domain(spec, CFG)
+    values = list(range(lo, hi + 1))
+    pts = [(qspec.point_cfg(CFG, spec, v), s)
+           for v in values for s in spec.seeds]
+    rows = sweep.run_dyn_points(canonical_fault_cfg(pts[0][0]), pts,
+                                record=False)
+    n_s = len(spec.seeds)
+    oks = {v: qspec.verdict(CFG.protocol, rows[i * n_s:(i + 1) * n_s], spec)
+           for i, v in enumerate(values)}
+    passing = [v for v in values if oks[v]]
+    failing = [v for v in values if not oks[v]]
+    return (max(passing) if passing else None,
+            min(failing) if failing else None, len(values))
+
+
+def test_engine_answer_matches_dense_reference():
+    spec = parse_query(Q_MAXF)
+    res = run_query(CFG, spec)
+    f_max, first_failing, dense_n = _dense_boundary(spec)
+    assert res["answer"]["f_max"] == f_max
+    assert res["answer"]["first_failing"] == first_failing
+    # the adaptive search evaluated strictly fewer values than the grid
+    assert res["run"]["values_evaluated"] < dense_n
+    assert res["run"]["monotonicity_violations"] == 0
+    assert invariants.check_query_trail(res) == []
+
+
+def test_engine_bisection_is_deterministic():
+    spec = parse_query(Q_MAXF)
+    a, b = run_query(CFG, spec), run_query(CFG, spec)
+    drop = {k: v for k, v in a.items() if k != "run"}
+    assert obs.canonical_json(drop) == obs.canonical_json(
+        {k: v for k, v in b.items() if k != "run"})
+
+
+def test_engine_warmup_is_the_only_compile():
+    # a unique sim_ms: this canonical structure is cold in the registry
+    cfg = SimConfig(**dict(QTPL, sim_ms=416))
+    before = aotcache.registry.stats()["misses"]
+    res = run_query(cfg, parse_query(Q_MAXF))
+    misses = aotcache.registry.stats()["misses"] - before
+    # fault counts and seeds are operands, every generation pads to the
+    # same lane count -> the warmup generation pays the ONE compile
+    assert misses == 1, f"search compiled {misses} executables, want 1"
+    assert res["run"]["steps"] >= 2           # it actually refined
+    # constant lanes per generation: width lanes x seeds, no exceptions
+    lanes = res["run"]["lanes"]
+    assert lanes == res["run"]["dispatches"] * 2 * 2
+
+
+def test_engine_edge_answers():
+    # hi pinned below the cliff: the predicate holds everywhere
+    res = run_query(CFG, parse_query(dict(Q_MAXF, hi=1)))
+    assert res["answer"] == {"f_max": 1, "first_failing": None,
+                             "param": "n_crashed", "domain": [0, 1]}
+    # lo pinned above the cliff: the predicate fails everywhere
+    res = run_query(CFG, parse_query(dict(Q_MAXF, lo=3)))
+    assert res["answer"] == {"f_max": None, "first_failing": 3,
+                             "param": "n_crashed", "domain": [3, 7]}
+    assert invariants.check_query_trail(res) == []
+
+
+# ----------------------------------------------- durability & key hygiene ---
+
+def test_query_keys_disjoint_from_grid_keys(tmp_path):
+    """The same canonical content journaled as a grid chunk and as a
+    query generation must produce DIFFERENT keys (the ``+q<step>``
+    namespace) with the SAME content hash prefix."""
+    spec = parse_query(Q_MAXF)
+    qj = journal_mod.SweepJournal(str(tmp_path / "q.journal"))
+    res = run_query(CFG, spec, journal=qj)
+    # a grid run over exactly the warmup generation's points
+    lo, hi = qspec.resolve_domain(spec, CFG)
+    pts = [(qspec.point_cfg(CFG, spec, v), s)
+           for v in (lo, hi) for s in spec.seeds]
+    gj = journal_mod.SweepJournal(str(tmp_path / "g.journal"))
+    # n_out matches the engine's (it is part of the key identity): the
+    # two keys then hash the SAME content and differ only by namespace
+    sweep.run_dyn_points(canonical_fault_cfg(pts[0][0]), pts,
+                         record=False, journal=gj, n_out=len(pts))
+    qkeys, gkeys = set(qj.completed()), set(gj.completed())
+    assert qkeys and gkeys
+    assert not qkeys & gkeys                   # disjoint namespaces
+    assert all("+q" in k for k in qkeys)
+    assert all("+" not in k for k in gkeys)    # grid keys stay pure hex
+    # identical content, differing ONLY by the namespace suffix
+    gen0 = next(k for k in qkeys if k.endswith("+q0"))
+    assert gen0[:-len("+q0")] in gkeys
+    assert invariants.check_sweep_journal(qj) == []
+    assert invariants.check_sweep_journal(gj) == []
+
+
+def test_query_key_suffix_never_collides_with_probe_suffix():
+    assert journal_mod.query_key_suffix(3) == "+q3"
+    key = journal_mod.query_chunk_key(
+        canonical_fault_cfg(CFG), 3, [(CFG, 0)])
+    assert key.endswith("+q3") and "+p" not in key
+
+
+def test_journal_replay_is_bit_equal_with_zero_dispatches(tmp_path):
+    path = str(tmp_path / "replay.journal")
+    spec = parse_query(Q_MAXF)
+    first = run_query(CFG, spec, journal=journal_mod.SweepJournal(path))
+    assert first["run"]["dispatches"] == first["run"]["steps"]
+    # a FRESH journal instance re-reads disk: the pure replay
+    again = run_query(CFG, spec, journal=journal_mod.SweepJournal(path))
+    assert again["run"]["dispatches"] == 0
+    assert again["run"]["cached_steps"] == again["run"]["steps"]
+    for k in ("query", "answer", "trail", "points"):
+        assert obs.canonical_json(first[k]) == obs.canonical_json(again[k])
+
+
+# --------------------------------------------------- run_dyn_points meta ----
+
+def test_run_dyn_points_with_index_fast_path():
+    pts = [(CFG, 11), (CFG, 12), (CFG, 13)]
+    rows, meta = sweep.run_dyn_points(canonical_fault_cfg(CFG), pts,
+                                      record=False, with_index=True)
+    assert len(rows) == 3
+    assert meta["dispatches"] == 1 and meta["pad"] == 0
+    assert [(r["point"], r["seed"]) for r in meta["rows"]] == \
+        [(0, 11), (1, 12), (2, 13)]
+
+
+def test_run_dyn_points_single_point_no_pad(tmp_path):
+    j = journal_mod.SweepJournal(str(tmp_path / "one.journal"))
+    rows, meta = sweep.run_dyn_points(
+        canonical_fault_cfg(CFG), [(CFG, 42)], record=False,
+        journal=j, with_index=True)
+    assert len(rows) == 1
+    assert meta["lanes"] == 1 and meta["pad"] == 0
+    assert len(meta["chunks"]) == 1 and not meta["chunks"][0]["cached"]
+    # the second run answers from the journal: 0 dispatches
+    rows2, meta2 = sweep.run_dyn_points(
+        canonical_fault_cfg(CFG), [(CFG, 42)], record=False,
+        journal=journal_mod.SweepJournal(str(tmp_path / "one.journal")),
+        with_index=True)
+    assert meta2["dispatches"] == 0 and meta2["chunks"][0]["cached"]
+    assert obs.canonical_json(rows) == obs.canonical_json(rows2)
+
+
+def test_run_dyn_points_key_suffix(tmp_path):
+    j = journal_mod.SweepJournal(str(tmp_path / "sfx.journal"))
+    _, meta = sweep.run_dyn_points(
+        canonical_fault_cfg(CFG), [(CFG, 7), (CFG, 8)], record=False,
+        journal=j, key_suffix="+q5", with_index=True)
+    assert all(c["key"].endswith("+q5") for c in meta["chunks"])
+    assert set(j.completed()) == {c["key"] for c in meta["chunks"]}
+
+
+# ----------------------------------------------------------- invariants -----
+
+def test_check_query_trail_flags_tampering(tmp_path):
+    j = journal_mod.SweepJournal(str(tmp_path / "t.journal"))
+    res = run_query(CFG, parse_query(Q_MAXF), journal=j)
+    assert invariants.check_query_trail(res, journal=j) == []
+    # a re-probed value
+    bad = json.loads(obs.canonical_json(res))
+    bad["trail"][1]["values"] = list(bad["trail"][0]["values"][:1])
+    bad["trail"][1]["verdicts"] = [[bad["trail"][0]["values"][0], True]]
+    assert invariants.check_query_trail(bad)
+    # an answer contradicting its own verdicts
+    bad = json.loads(obs.canonical_json(res))
+    bad["answer"]["f_max"] = bad["answer"]["first_failing"]
+    assert invariants.check_query_trail(bad)
+    # a chunk key outside the +q namespace
+    bad = json.loads(obs.canonical_json(res))
+    bad["trail"][0]["keys"] = [bad["trail"][0]["keys"][0].split("+")[0]]
+    assert any("suffix" in v for v in invariants.check_query_trail(bad))
+
+
+# ------------------------------------------------------------- serving ------
+
+def test_serve_query_request_end_to_end(tmp_path):
+    from blockchain_simulator_tpu.serve import ScenarioServer
+
+    ref = run_query(CFG, parse_query(Q_MAXF),
+                    journal=journal_mod.SweepJournal(
+                        str(tmp_path / "ref.journal")))
+    with telemetry.capture() as spans:
+        with ScenarioServer(
+                journal_path=str(tmp_path / "srv.journal")) as srv:
+            resp = srv.request(dict(QTPL, id="q1", timeout_s=300.0,
+                                    query=dict(Q_MAXF)), wait_s=300.0)
+            ordinary = srv.request(dict(QTPL, seed=3, id="r1"),
+                                   wait_s=300.0)
+            stats = srv.stats()
+    assert resp["status"] == "ok" and ordinary["status"] == "ok"
+    assert resp["answer"] == ref["answer"]
+    assert obs.canonical_json(resp["trail"]) == obs.canonical_json(
+        ref["trail"])
+    assert "points" not in resp                # queue-sized, not grid-sized
+    assert stats["queries"] == 1
+    assert stats["served"] == 2
+    assert invariants.check_query_trail(resp) == []
+    # every query.step span is parented under the request's root span
+    tree = invariants.normalize_spans(spans)
+    steps = [p for p in tree if "query.step" in p]
+    assert steps and all(p.startswith("serve.request/") for p in steps)
+
+
+def test_parse_request_query_validation():
+    req = parse_request(dict(QTPL, id="q", query=dict(Q_MAXF)), "f")
+    assert req.query is not None and req.query.kind == "max_f_surviving"
+    with pytest.raises(InvalidRequestError, match="kind"):
+        parse_request(dict(QTPL, query={"kind": "nope"}), "f")
+    with pytest.raises(InvalidRequestError, match="ceiling"):
+        parse_request(dict(QTPL, query=dict(Q_MAXF, hi=99)), "f")
+    with pytest.raises(InvalidRequestError, match="probe"):
+        parse_request(dict(QTPL, probe={"mode": "record"},
+                           query=dict(Q_MAXF)), "f")
+    with pytest.raises(InvalidRequestError):
+        parse_request(dict(QTPL, query="not-a-dict"), "f")
+
+
+# ----------------------------------------------------------------- slow -----
+
+@pytest.mark.slow
+def test_min_k_finality_per_degree_search():
+    """The documented per-k exception: degree is program structure, so
+    the search compiles once per probed k — and still finds the overlay
+    boundary (at n=8/400 ms only the complete graph commits in time)."""
+    res = run_query(CFG, parse_query({"kind": "min_k_finality",
+                                      "seeds": [0]}))
+    assert res["answer"]["k_min"] == 7
+    assert res["answer"]["last_failing"] == 6
+    assert res["run"]["values_evaluated"] < 7   # adaptive beat the grid
+    assert invariants.check_query_trail(res) == []

@@ -150,7 +150,7 @@ def trajectory(rows: list[dict]) -> dict[str, list[dict]]:
 UNGATED_SUFFIXES = ("_findings", "_compile_s", "_p50_ms")
 UNGATED_PREFIXES = ("graph_", "comms_", "chaos_", "fleet_", "journal_",
                     "resume_", "telemetry_", "topo_", "shard_topo_full_",
-                    "consobs_")
+                    "consobs_", "query_")
 
 # Committed per-metric baselines: the first trajectory row of each listed
 # metric, pinned in-repo so a series without a second runs.jsonl sample

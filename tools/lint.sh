@@ -23,6 +23,11 @@
 #     SIGKILLs a real journaled-sweep subprocess mid-grid and demands
 #     the resume recompute at most the in-flight chunk with rows
 #     bit-equal (RESUME=0 skips);
+#   - the query drill (`tools/query_drill.py --quick`) answers one
+#     adaptive query against its dense grid (same boundary, bit-equal
+#     rows) and SIGKILLs a journaled-query subprocess mid-search,
+#     demanding the resume recompute zero completed steps (QUERY=0
+#     skips);
 #   - `tools/bench_compare.py` sees no metric drop beyond its threshold.
 #
 # When $BLOCKSIM_RUNS_JSONL is set the lint runs themselves land in
@@ -256,6 +261,24 @@ if [ "${CONSOBS:-1}" != "0" ]; then
     consobs_rc=$?
     if [ "$consobs_rc" -ne 0 ]; then
         echo "lint.sh: consensus obs report FAILED (rc=$consobs_rc)" >&2
+        rc=1
+    fi
+fi
+
+# Adaptive-query drill (tools/query_drill.py --quick): the bisection
+# engine vs its dense grid (identical boundary, bit-equal rows under the
+# exact sampler) plus a subprocess SIGKILL mid-search whose resume must
+# serve every completed generation from the journal (0 recomputed
+# steps); lands query_dispatch_savings_x / query_invariant_violations in
+# runs.jsonl (charted, never gated by bench_compare — the drill's own
+# exit code is the gate).  QUERY=0 skips; the full run writes
+# ARTIFACT_query.json.
+if [ "${QUERY:-1}" != "0" ]; then
+    echo "== query drill =="
+    python tools/query_drill.py --quick
+    query_rc=$?
+    if [ "$query_rc" -ne 0 ]; then
+        echo "lint.sh: query drill FAILED (rc=$query_rc)" >&2
         rc=1
     fi
 fi

@@ -64,6 +64,11 @@ RULE_SUMMARIES = {
         "P(\"nodes\")-declared operand (the partitioner is rematerializing "
         "a sharded table on every device)"
     ),
+    "prologue-global-gather": (
+        "prologue all-gather whose output shape carries the GLOBAL node "
+        "dimension — per-device memory for that value scales with global "
+        "N before the tick loop even starts"
+    ),
     "collective-in-tick-loop": (
         "collective inside a while/scan body — a per-TICK communication "
         "cost; every occurrence must be baselined with a justification"
@@ -181,6 +186,38 @@ def check_program(program: str, module, colls, meta,
                     "is rematerializing the sharded table on every device — "
                     "the consumer indexes it globally; reroute through the "
                     "local shard (KNOWN_ISSUES #0p)"
+                ),
+            ))
+
+    # prologue-global-gather: any PROLOGUE all-gather whose output shape
+    # carries the global node dimension — not just exact table shapes.
+    # A [N_global, ...] value materialized before the loop means some
+    # device holds memory scaling with global N, defeating the node-dim
+    # sharding even when the loop body itself stays shard-local.  Exact
+    # full-table shapes are already reported by table-regather above.
+    if declared:
+        global_n = max(dims[0] for dims, _ in declared if dims)
+        regathered = {(dt, dims) for dims, dt in declared}
+        prologue_hits: dict[str, list] = {}
+        for c in colls:
+            if c.opcode != "all-gather" or c.in_loop:
+                continue
+            arrays = hlo.shape_dims(c.shape)
+            if any((dt, dims) in regathered for dt, dims in arrays):
+                continue  # counted by table-regather
+            if any(global_n in dims for _, dims in arrays):
+                prologue_hits.setdefault(c.shape, []).append(c)
+        for shape, group in sorted(prologue_hits.items()):
+            findings.append(CommsFinding(
+                rule="prologue-global-gather", program=program,
+                detail=f"all-gather {shape}", count=len(group),
+                message=(
+                    f"`{program}` prologue all-gathers {shape} "
+                    f"x{len(group)}: the output carries the global node "
+                    f"dimension ({global_n}) — a per-device value scaling "
+                    "with global N is materialized before the tick loop; "
+                    "bucket the reads by owning shard and exchange with "
+                    "all_to_all instead"
                 ),
             ))
 

@@ -271,7 +271,7 @@ def _reply_contribs(key, ok_wire, no_wire, cmd_wire, lo, hi, drop, axis, ids, p,
 
 
 def step(cfg, state: PaxosState, bufs: PaxosBufs, t, tkey, *,
-         topo_tables=None):
+         topo_tables=None, exchange=None):
     n, p = cfg.n, cfg.paxos_n_proposers
     axis = cfg.mesh_axis
     lo, hi = cfg.one_way_range()
@@ -306,7 +306,11 @@ def step(cfg, state: PaxosState, bufs: PaxosBufs, t, tkey, *,
     kreg = cfg.topology == "kregular"
     inmask = None
     if kreg:
-        nbr_in_loc, _ = gd.local_tables(cfg, ids, tables=topo_tables)
+        # paxos never reads cross-row state through the tables (the inmask
+        # below is row-local), so exchange mode only switches the row
+        # indexing to the ids=None operand pass-through
+        nbr_in_loc, _ = gd.local_tables(
+            cfg, None if exchange is not None else ids, tables=topo_tables)
         inmask = (
             nbr_in_loc[:, :, None] == jnp.arange(p)[None, None, :]
         ).any(axis=1)  # [N_loc, P]

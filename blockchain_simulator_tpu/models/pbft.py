@@ -238,7 +238,8 @@ def _scatter_window_events(acc_add, acc_max, acc_min, events, eff_sid, t, s):
     return out
 
 
-def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey, *, topo_tables=None):
+def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey, *, topo_tables=None,
+         exchange=None):
     n, s = cfg.n, cfg.pbft_max_slots
     w = eff_window(cfg)
     exact = w == s
@@ -314,9 +315,12 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey, *, topo_tables=None):
     nbr_in_loc = nbr_out_loc = None
     if kreg:
         # topo_tables=None bakes the tables as trace constants (audit
-        # scale); the sharded programs pass them as operands instead
-        nbr_in_loc, nbr_out_loc = gd.local_tables(cfg, ids,
-                                                  tables=topo_tables)
+        # scale); the sharded programs pass them as operands instead.  In
+        # exchange mode the operands ARE this trace's rows already —
+        # ids=None skips the take that GSPMD would turn into a full-table
+        # all-gather (the retired table-regather debt)
+        nbr_in_loc, nbr_out_loc = gd.local_tables(
+            cfg, None if exchange is not None else ids, tables=topo_tables)
     seen_pp, seen_vc = state.seen_pp, state.seen_vc
     pp_fwd = vc_fwd = None
     nbrs_loc = None
@@ -401,7 +405,7 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey, *, topo_tables=None):
         # of total-minus-self — and rides the same fused chain on the same
         # key (equal counts at k = N-1, hence bit-equal).
         if kreg:
-            n_peers = gd.out_counts(voters, nbr_out_loc, ids, axis)
+            n_peers = gd.out_counts(voters, nbr_out_loc, ids, axis, exchange)
         else:
             n_voters = voters.astype(jnp.int32).sum()
             if axis is not None:
@@ -425,7 +429,7 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey, *, topo_tables=None):
             lambda: (
                 gd.roundtrip_reply_counts_kreg(
                     k_rt, prep_active, nbr_out_loc, ids, lo, hi, drop,
-                    peer_mask=voters, axis=axis, impl=eimpl,
+                    peer_mask=voters, axis=axis, impl=eimpl, xg=exchange,
                 ) if kreg else dv.roundtrip_reply_counts_dense(
                     k_rt, prep_active, lo, hi, drop, peer_mask=voters,
                     axis=axis, impl=eimpl,
@@ -475,7 +479,7 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey, *, topo_tables=None):
             lambda: (
                 gd.push_bcast_slots_stat_kreg(
                     commit, t, lo, k_cm, commit_mat, nbr_in_loc, ids,
-                    ow_probs, drop, axis=axis, mode=smode,
+                    ow_probs, drop, axis=axis, mode=smode, xg=exchange,
                 ) if kreg else dv.push_bcast_slots_stat(
                     commit, t, lo, k_cm, commit_mat, ow_probs, drop,
                     axis=axis, mode=smode,
@@ -489,7 +493,8 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey, *, topo_tables=None):
             (commit_mat > 0).any(),
             lambda: (
                 gd.bcast_slots_kreg(k_cm, commit_mat, nbr_in_loc, ids, lo,
-                                    hi, drop, axis=axis, impl=eimpl)
+                                    hi, drop, axis=axis, impl=eimpl,
+                                    xg=exchange)
                 if kreg else
                 dv.bcast_slots_dense(k_cm, commit_mat, lo, hi, drop,
                                      axis=axis, impl=eimpl)
@@ -605,11 +610,12 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey, *, topo_tables=None):
             send_block.any(),
             lambda: (
                 gd.bcast_window_value_max_stat_kreg(
-                    k_pp, pp_val, nbr_in_loc, ow_probs, drop, axis=axis)
+                    k_pp, pp_val, nbr_in_loc, ow_probs, drop, axis=axis,
+                    xg=exchange)
                 if stat else
                 gd.bcast_window_value_max_kreg(
                     k_pp, pp_val, nbr_in_loc, ids, lo, hi, drop, axis=axis,
-                    impl=eimpl)
+                    impl=eimpl, xg=exchange)
             ),
             zeros_w,
             axis,
@@ -670,10 +676,11 @@ def step(cfg, state: PbftState, bufs: PbftBufs, t, tkey, *, topo_tables=None):
             trigger.any(),
             lambda: (
                 gd.bcast_value_max_stat_kreg(k_vc, enc, nbr_in_loc, ow_probs,
-                                             drop, axis=axis)
+                                             drop, axis=axis, xg=exchange)
                 if stat else
                 gd.bcast_value_max_kreg(k_vc, trigger, enc, nbr_in_loc, ids,
-                                        lo, hi, drop, axis=axis, impl=eimpl)
+                                        lo, hi, drop, axis=axis, impl=eimpl,
+                                        xg=exchange)
             ),
             zeros_flat,
             axis,

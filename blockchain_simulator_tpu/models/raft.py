@@ -214,7 +214,8 @@ def init(cfg, key=None):
 
 
 
-def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey, *, topo_tables=None):
+def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey, *, topo_tables=None,
+         exchange=None):
     n = cfg.n
     axis = cfg.mesh_axis
     lo, hi = cfg.one_way_range()
@@ -273,8 +274,11 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey, *, topo_tables=None):
     kreg = cfg.topology == "kregular"
     nbr_in_loc = nbr_out_loc = inslot_loc = None
     if kreg:
+        # exchange mode: operands are already this trace's rows (ids=None
+        # pass-through — re-taking a sharded operand would regather it)
         nbr_in_loc, nbr_out_loc, inslot_loc = gd.local_tables(
-            cfg, ids, inslot=True, tables=topo_tables)
+            cfg, None if exchange is not None else ids, inslot=True,
+            tables=topo_tables)
     seen_vreq, seen_hb, seen_prop = state.seen_vreq, state.seen_hb, state.seen_prop
     vreq_fwd = hb_fwd = prop_fwd = None
     nbrs_loc = None
@@ -408,7 +412,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey, *, topo_tables=None):
         def reply_counts(wire):
             if kreg:
                 return gd.reply_counts_by_target_kreg(
-                    wire, grant_to, nbr_out_loc, ids, axis
+                    wire, grant_to, nbr_out_loc, ids, axis, exchange
                 )
             c = jnp.zeros((n,), jnp.int32).at[grant_to].add(
                 wire.astype(jnp.int32), mode="drop"
@@ -470,7 +474,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey, *, topo_tables=None):
             def _unicast(kk, wire):
                 return gd.unicast_reply_counts_kreg(
                     kk, wire, nbr_in_loc, nbr_out_loc, inslot_loc, ids,
-                    lo, hi, drop, axis=axis, impl=eimpl)
+                    lo, hi, drop, axis=axis, impl=eimpl, xg=exchange)
         else:
             def _unicast(kk, wire):
                 return dv.unicast_reply_counts_dense(
@@ -622,7 +626,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey, *, topo_tables=None):
             lambda: (
                 gd.bcast_value_max_stat_kreg(
                     k_vq, (ids + 1) * fire.astype(jnp.int32), nbr_in_loc,
-                    ow_probs, drop, axis=axis)
+                    ow_probs, drop, axis=axis, xg=exchange)
                 if kreg else
                 dv.bcast_value_max_stat(
                     k_vq, (ids + 1) * fire.astype(jnp.int32), ow_probs, drop,
@@ -637,7 +641,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey, *, topo_tables=None):
             fire.any(),
             lambda: gd.bcast_matrix_kreg(
                 k_vq, fire, fire.astype(jnp.int32), nbr_in_loc, ids, lo, hi,
-                drop, axis=axis, impl=eimpl),
+                drop, axis=axis, impl=eimpl, xg=exchange),
             jnp.zeros((hi - lo, n_loc, cfg.degree + 1), jnp.int32),
             axis,
         )
@@ -759,7 +763,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey, *, topo_tables=None):
                 # full-mesh stat arm below
                 lambda: gd.bcast_counts_stat_kreg(
                     k_hb, plain_send, nbr_in_loc, ids, ow_probs, drop,
-                    axis=axis, mode="exact"),
+                    axis=axis, mode="exact", xg=exchange),
                 zeros_flat,
                 axis,
             )
@@ -768,7 +772,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey, *, topo_tables=None):
                 lambda: gd.bcast_value_max_stat_kreg(
                     jax.random.fold_in(k_hb, 1),
                     (ids + 1) * prop_send.astype(jnp.int32), nbr_in_loc,
-                    ow_probs, drop, axis=axis),
+                    ow_probs, drop, axis=axis, xg=exchange),
                 zeros_flat,
                 axis,
             )
@@ -777,7 +781,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey, *, topo_tables=None):
                 plain_send.any(),
                 lambda: gd.bcast_counts_kreg(
                     k_hb, plain_send, nbr_in_loc, ids, lo, hi, drop,
-                    axis=axis, impl=eimpl),
+                    axis=axis, impl=eimpl, xg=exchange),
                 zeros_flat,
                 axis,
             )
@@ -786,7 +790,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey, *, topo_tables=None):
                 lambda: gd.bcast_value_max_kreg(
                     jax.random.fold_in(k_hb, 1), prop_send,
                     (ids + 1) * prop_send.astype(jnp.int32), nbr_in_loc,
-                    ids, lo, hi, drop, axis=axis, impl=eimpl),
+                    ids, lo, hi, drop, axis=axis, impl=eimpl, xg=exchange),
                 zeros_flat,
                 axis,
             )
@@ -880,8 +884,8 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey, *, topo_tables=None):
         # The kregular overlay swaps only the per-sender peer counts for
         # out-table gathers (equal at k = N-1, same keys/chain).
         if kreg:
-            ok_peers = gd.out_counts(voters, nbr_out_loc, ids, axis)
-            bad_peers = gd.out_counts(liars, nbr_out_loc, ids, axis)
+            ok_peers = gd.out_counts(voters, nbr_out_loc, ids, axis, exchange)
+            bad_peers = gd.out_counts(liars, nbr_out_loc, ids, axis, exchange)
         else:
             n_voters = _psum_scalar(voters.astype(jnp.int32).sum(), axis)
             n_liars = _psum_scalar(liars.astype(jnp.int32).sum(), axis)
@@ -907,7 +911,7 @@ def step(cfg, state: RaftState, bufs: RaftBufs, t, tkey, *, topo_tables=None):
             def _rt(kk, peers):
                 return gd.roundtrip_reply_counts_kreg(
                     kk, prop_send, nbr_out_loc, ids, lo, hi, drop,
-                    peer_mask=peers, axis=axis, impl=eimpl)
+                    peer_mask=peers, axis=axis, impl=eimpl, xg=exchange)
         else:
             def _rt(kk, peers):
                 return dv.roundtrip_reply_counts_dense(

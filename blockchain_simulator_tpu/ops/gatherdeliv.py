@@ -30,6 +30,17 @@ fine at audit scale), or as real program OPERANDS
 the mesh-sharded programs can shard them over the node dimension
 (KNOWN_ISSUES #0n's escape hatch, implemented by parallel/sweep.py's
 ``sharded_topo_sim_fn``).
+
+Shard-local exchange mode: every kregular primitive also takes ``xg=``, a
+``parallel.partition.NeighborExchange``.  With it, the cross-row neighbor
+reads that :func:`_nbr_rows` would realize as all_gather + ``jnp.take``
+become owner-bucketed ``all_to_all`` exchanges — same values (a pure
+permutation + local gather, bit-equal by construction), but no tensor at
+global shape ever exists on a device.  ``xg`` rides the GSPMD-partitioned
+(global-view) trace: ``axis`` stays None there, the RNG draw shapes are
+untouched, and the exchange islands are shard_map regions inside the same
+jit program (parallel/sweep.py builds them per executable from the plans
+in topo/spec.owner_bucket_plan).
 """
 
 from __future__ import annotations
@@ -65,14 +76,32 @@ def table_operands(cfg, inslot: bool = False):
     return tuple(tabs)
 
 
-def local_tables(cfg, ids, inslot: bool = False, tables=None):
+def local_tables(cfg, ids, inslot: bool = False, tables=None, base: int = 0):
     """The overlay tables of ``cfg``, sliced to this shard's rows: ``(in,
     out)`` or ``(in, out, inslot)`` — the one localization call site the
-    three models share.  ``ids`` is the shard's global row ids, so
-    unsharded this is the whole table.  With ``tables=None`` the tables
-    are trace constants (the audit-scale default); passing the
-    :func:`table_operands` arrays (possibly tracers) keeps them program
-    operands — same values, same gather, no baked constant."""
+    three models share.
+
+    Layout contract: row indexing varies, row VALUES never do — every
+    returned table row holds GLOBAL node ids (sorted ascending;
+    ``inslot`` values are slot indices, not ids).  Three row-indexing
+    modes:
+
+    - ``ids`` global (the default): the shard's global row ids — unsharded
+      that is the whole table, and the take is a row slice.
+    - ``ids`` shard-offset + ``base``: ``ids`` counts 0..n_loc-1 within
+      this shard and ``base`` is the shard's first global row, so the
+      selected rows are ``ids + base`` (lets shard_map bodies pass their
+      local iota without materializing global ids).
+    - ``ids=None``: pass-through — the ``tables`` operands are ALREADY
+      this trace's rows (the shard-local exchange mode of
+      parallel/sweep.py, where re-gathering rows of a ``P(nodes)``-sharded
+      operand would make GSPMD all-gather the whole table: the retired
+      ``table-regather`` debt).  No take is emitted at all.
+
+    With ``tables=None`` the tables are trace constants (the audit-scale
+    default); passing the :func:`table_operands` arrays (possibly tracers)
+    keeps them program operands — same values, same gather, no baked
+    constant."""
     if tables is None:
         tables = table_operands(cfg, inslot=inslot)
     elif len(tables) != (3 if inslot else 2):
@@ -80,28 +109,51 @@ def local_tables(cfg, ids, inslot: bool = False, tables=None):
             f"local_tables: expected {3 if inslot else 2} tables for "
             f"inslot={inslot}, got {len(tables)}"
         )
-    return tuple(jnp.take(jnp.asarray(t), ids, axis=0) for t in tables)
+    if ids is None:
+        return tuple(jnp.asarray(t) for t in tables)
+    rows = ids if base == 0 else ids + base
+    return tuple(jnp.take(jnp.asarray(t), rows, axis=0) for t in tables)
+
+
+def _nbr_rows(x, idx_loc, axis=None, xg=None, kind="in", col=None):
+    """Every cross-row neighbor read goes through this one door: the
+    values of ``x`` at the global row ids in ``idx_loc`` (``[N_loc, K]``),
+    i.e. ``take(x_global, idx_loc, axis=0)`` — or, with ``col`` (``[N_loc,
+    K]`` column picks into 2-D ``x``), the elementwise
+    ``take(x_global.reshape(-1), idx_loc * x.shape[1] + col)``.
+
+    Fallback (``xg=None``): globalize ``x`` with all_gather (identity when
+    ``axis`` is None — the single-device and GSPMD global-view traces) and
+    gather.  Exchange mode: a :class:`~blockchain_simulator_tpu.parallel.
+    partition.NeighborExchange` ships only the owner-bucketed rows via
+    ``all_to_all`` — bit-equal values, O(N*K/D) communication, no global
+    tensor.  ``kind`` names which table's plan the ids follow ("in" =
+    ``nbr_in`` rows, "out" = ``nbr_out`` rows)."""
+    if xg is not None:
+        return xg(x, kind=kind, col=col)
+    x_g = dv._gather(x, axis)
+    if col is None:
+        return jnp.take(x_g, idx_loc, axis=0)
+    return jnp.take(x_g.reshape(-1), idx_loc * x.shape[1] + col)
 
 
 # ------------------------------------------------------------ gather sums ---
 
 
-def in_counts(x, nbr_in_loc, ids, axis=None):
+def in_counts(x, nbr_in_loc, ids, axis=None, xg=None):
     """Per-receiver sum of a local int/bool ``[N_loc]`` vector over TRUE
     in-neighbors (self slot excluded): the kregular replacement for the
     dense stat chains' ``total - own`` sender counts.  Returns [N_loc]."""
-    x_g = dv._gather(x.astype(jnp.int32), axis)
-    vals = jnp.take(x_g, nbr_in_loc)                     # [N_loc, K]
+    vals = _nbr_rows(x.astype(jnp.int32), nbr_in_loc, axis, xg)  # [N_loc, K]
     notself = (nbr_in_loc != ids[:, None]).astype(jnp.int32)
     return (vals * notself).sum(1)
 
 
-def out_counts(x, nbr_out_loc, ids, axis=None):
+def out_counts(x, nbr_out_loc, ids, axis=None, xg=None):
     """Per-sender count of its out-neighbors inside a local mask ``x``
     (self excluded) — the gathered ``n_peers`` of the round-trip stat
     chains.  Returns [N_loc]."""
-    x_g = dv._gather(x.astype(jnp.int32), axis)
-    vals = jnp.take(x_g, nbr_out_loc)
+    vals = _nbr_rows(x.astype(jnp.int32), nbr_out_loc, axis, xg, "out")
     notself = (nbr_out_loc != ids[:, None]).astype(jnp.int32)
     return (vals * notself).sum(1)
 
@@ -109,16 +161,18 @@ def out_counts(x, nbr_out_loc, ids, axis=None):
 # ------------------------------------------------- edge-exact (slot-major) ---
 
 
-def _slot_hits(key, send_g, nbr_in_loc, ids, lo, hi, drop, axis, impl):
+def _slot_hits(key, src_act, nbr_in_loc, ids, lo, hi, drop, axis, impl):
     """[B, K, N_loc] 0/1 delivery indicators — the slot-major twin of
     dv._edge_hits' [B, N_glob, N_loc]: delay/drop tensors are [K, N_loc]
-    on the SAME key, so at K = N (identity tables) the arrays are equal."""
+    on the SAME key, so at K = N (identity tables) the arrays are equal.
+    ``src_act`` is the [N_loc, K] int32 send activity of each slot's
+    SOURCE node (a :func:`_nbr_rows` read of the sender flags — self slot
+    not yet masked; the mask lands here)."""
     n_loc, k1 = nbr_in_loc.shape
     k = dv._shard_key(key, axis)
     d = sample_edge_delays(k, (k1, n_loc), lo, hi, impl)
-    src = nbr_in_loc.T                                    # [K, N_loc]
-    notself = src != ids[None, :]
-    mask = jnp.take(send_g.astype(jnp.int32), src) * notself.astype(jnp.int32)
+    notself = nbr_in_loc.T != ids[None, :]                # [K, N_loc]
+    mask = src_act.T * notself.astype(jnp.int32)
     if drop > 0.0:
         keep = jax.random.bernoulli(
             jax.random.fold_in(k, 0x0D0D), 1.0 - drop, (k1, n_loc)
@@ -128,67 +182,67 @@ def _slot_hits(key, send_g, nbr_in_loc, ids, lo, hi, drop, axis, impl):
 
 
 def bcast_counts_kreg(key, send, nbr_in_loc, ids, lo, hi, drop=0.0, axis=None,
-                      impl="threefry"):
+                      impl="threefry", xg=None):
     """Overlay broadcast -> per-receiver arrival counts.  [B, N_loc]."""
-    send_g = dv._gather(send, axis)
-    return _slot_hits(key, send_g, nbr_in_loc, ids, lo, hi, drop, axis,
+    src_act = _nbr_rows(send.astype(jnp.int32), nbr_in_loc, axis, xg)
+    return _slot_hits(key, src_act, nbr_in_loc, ids, lo, hi, drop, axis,
                       impl).sum(1)
 
 
 def bcast_value_max_kreg(key, send, value, nbr_in_loc, ids, lo, hi, drop=0.0,
-                         axis=None, impl="threefry"):
+                         axis=None, impl="threefry", xg=None):
     """Overlay value broadcast (>0; 0 = empty), max-combined.  [B, N_loc]."""
-    send_g = dv._gather(send, axis)
-    value_g = dv._gather(value, axis)
-    hits = _slot_hits(key, send_g, nbr_in_loc, ids, lo, hi, drop, axis, impl)
-    val_t = jnp.take(value_g.astype(jnp.int32), nbr_in_loc.T)  # [K, N_loc]
+    src_act = _nbr_rows(send.astype(jnp.int32), nbr_in_loc, axis, xg)
+    hits = _slot_hits(key, src_act, nbr_in_loc, ids, lo, hi, drop, axis, impl)
+    val_t = _nbr_rows(value.astype(jnp.int32), nbr_in_loc, axis, xg).T
     return (hits * val_t[None]).max(1)
 
 
 def bcast_slots_kreg(key, slot_mat, nbr_in_loc, ids, lo, hi, drop=0.0,
-                     axis=None, impl="threefry"):
+                     axis=None, impl="threefry", xg=None):
     """Overlay slot-keyed broadcast (pbft COMMIT waves): arrival counts per
-    (receiver, slot) gathered over in-neighbors.  [B, N_loc, S]."""
-    slot_g = dv._gather(slot_mat.astype(jnp.int32), axis)       # [N, S]
-    send_g = slot_g.max(axis=1) > 0
-    hits = _slot_hits(key, send_g, nbr_in_loc, ids, lo, hi, drop, axis, impl)
-    slot_slot = jnp.take(slot_g, nbr_in_loc, axis=0)            # [N_loc, K, S]
-    return jnp.einsum("bkj,jks->bjs", hits, slot_slot)
+    (receiver, slot) gathered over in-neighbors.  [B, N_loc, S].
+
+    The sender flag is derived AFTER the neighbor-row read (``max`` over
+    the slot dim commutes with a row gather), so exchange mode ships the
+    [.., S] slot rows once and pays no second collective for the flags."""
+    slot_rows = _nbr_rows(slot_mat.astype(jnp.int32), nbr_in_loc, axis, xg)
+    src_act = (slot_rows.max(2) > 0).astype(jnp.int32)          # [N_loc, K]
+    hits = _slot_hits(key, src_act, nbr_in_loc, ids, lo, hi, drop, axis, impl)
+    return jnp.einsum("bkj,jks->bjs", hits, slot_rows)
 
 
 def bcast_window_value_max_kreg(key, value_mat, nbr_in_loc, ids, lo, hi,
-                                drop=0.0, axis=None, impl="threefry"):
+                                drop=0.0, axis=None, impl="threefry", xg=None):
     """Overlay per-window value broadcast (pbft PRE_PREPARE), receiver
     max-combines per window.  [B, N_loc, W]."""
-    value_g = dv._gather(value_mat.astype(jnp.int32), axis)     # [N, W]
-    send_g = value_g.max(axis=1) > 0
-    hits = _slot_hits(key, send_g, nbr_in_loc, ids, lo, hi, drop, axis, impl)
-    val_slot = jnp.take(value_g, nbr_in_loc, axis=0)            # [N_loc, K, W]
-    return (hits[:, :, :, None] * jnp.swapaxes(val_slot, 0, 1)[None]).max(1)
+    val_rows = _nbr_rows(value_mat.astype(jnp.int32), nbr_in_loc, axis, xg)
+    src_act = (val_rows.max(2) > 0).astype(jnp.int32)           # [N_loc, K]
+    hits = _slot_hits(key, src_act, nbr_in_loc, ids, lo, hi, drop, axis, impl)
+    return (hits[:, :, :, None] * jnp.swapaxes(val_rows, 0, 1)[None]).max(1)
 
 
 def bcast_matrix_kreg(key, send, value, nbr_in_loc, ids, lo, hi, drop=0.0,
-                      axis=None, impl="threefry"):
+                      axis=None, impl="threefry", xg=None):
     """Identity-preserving overlay broadcast (raft VOTE_REQ): ``value``
     lands at ``[b, receiver_local, in_slot]`` — the K-slot twin of the
     dense [B, N_loc, N_glob] matrix channel.  Slot s of receiver j is
     sender ``nbr_in_loc[j, s]`` (rows sorted, so argmax-over-slots keeps
     the dense path's lowest-candidate-id tie-break).  [B, N_loc, K]."""
-    send_g = dv._gather(send, axis)
-    value_g = dv._gather(value, axis)
-    hits = _slot_hits(key, send_g, nbr_in_loc, ids, lo, hi, drop, axis, impl)
-    val_t = jnp.take(value_g.astype(jnp.int32), nbr_in_loc.T)   # [K, N_loc]
+    src_act = _nbr_rows(send.astype(jnp.int32), nbr_in_loc, axis, xg)
+    hits = _slot_hits(key, src_act, nbr_in_loc, ids, lo, hi, drop, axis, impl)
+    val_t = _nbr_rows(value.astype(jnp.int32), nbr_in_loc, axis, xg).T
     return jnp.swapaxes(hits * val_t[None], 1, 2)
 
 
 def roundtrip_reply_counts_kreg(key, send, nbr_out_loc, ids, lo, hi, drop=0.0,
-                                peer_mask=None, axis=None, impl="threefry"):
+                                peer_mask=None, axis=None, impl="threefry",
+                                xg=None):
     """Short-circuited overlay round trip: sender i reaches its
     out-neighbors, every eligible peer replies instantly with an
     independent return delay.  [B2, N_loc], offset 2*lo."""
     n_loc, k1 = nbr_out_loc.shape
     peers = jnp.ones((n_loc,), bool) if peer_mask is None else peer_mask
-    peers_g = dv._gather(peers, axis)
     k = dv._shard_key(key, axis)
     d1 = sample_edge_delays(jax.random.fold_in(k, 1), (n_loc, k1), lo, hi, impl)
     d2 = sample_edge_delays(jax.random.fold_in(k, 2), (n_loc, k1), lo, hi, impl)
@@ -197,7 +251,7 @@ def roundtrip_reply_counts_kreg(key, send, nbr_out_loc, ids, lo, hi, drop=0.0,
     mask = (
         send.astype(jnp.int32)[:, None]
         * notself.astype(jnp.int32)
-        * jnp.take(peers_g.astype(jnp.int32), nbr_out_loc)
+        * _nbr_rows(peers.astype(jnp.int32), nbr_out_loc, axis, xg, "out")
     )
     if drop > 0.0:
         keep = jax.random.bernoulli(
@@ -214,7 +268,7 @@ def roundtrip_reply_counts_kreg(key, send, nbr_out_loc, ids, lo, hi, drop=0.0,
 
 def unicast_reply_counts_kreg(key, reply_slots, nbr_in_loc, nbr_out_loc,
                               inslot_loc, ids, lo, hi, drop=0.0, axis=None,
-                              impl="threefry"):
+                              impl="threefry", xg=None):
     """Route per-(replier, in-slot) reply counts back to each requester —
     WITHOUT a scatter: requester c gathers slot s of replier ``nbr_out_loc
     [c, s]`` through the precomputed ``inslot`` cross-index (topo/spec.py:
@@ -231,27 +285,25 @@ def unicast_reply_counts_kreg(key, reply_slots, nbr_in_loc, nbr_out_loc,
         )
         mask = mask * keep.astype(jnp.int32)
     r = reply_slots.astype(jnp.int32) * mask
-    r_g = dv._gather(r, axis)                 # [N, K] replier-major, global
-    d_g = dv._gather(d, axis)
-    flat = nbr_out_loc * k1 + inslot_loc      # [N_loc, K] requester-side
-    rv = jnp.take(r_g.reshape(-1), flat)
-    dd = jnp.take(d_g.reshape(-1), flat)
+    # requester-side flat col-select: slot inslot_loc[c, s] of replier row
+    # nbr_out_loc[c, s] — replier-major [N, K] globalized (or exchanged)
+    rv = _nbr_rows(r, nbr_out_loc, axis, xg, "out", col=inslot_loc)
+    dd = _nbr_rows(d, nbr_out_loc, axis, xg, "out", col=inslot_loc)
     return (
         (dd[None] == dv._bucket_iota(lo, hi, dd.ndim)).astype(jnp.int32)
         * rv[None]
     ).sum(2)
 
 
-def reply_counts_by_target_kreg(wire, target, nbr_out_loc, ids, axis=None):
+def reply_counts_by_target_kreg(wire, target, nbr_out_loc, ids, axis=None,
+                                xg=None):
     """Per-target reply totals WITHOUT the dense path's global scatter-add:
     target c gathers ``wire`` over its out-neighbors and keeps repliers
     whose decoded ``target`` id is c (a replier's target is always one of
     its in-neighbors, so the out-gather covers every reply).  The raft
     stat vote/ack router.  Returns [N_loc] int32."""
-    wire_g = dv._gather(wire.astype(jnp.int32), axis)
-    tgt_g = dv._gather(target, axis)
-    w = jnp.take(wire_g, nbr_out_loc)                    # [N_loc, K]
-    tg = jnp.take(tgt_g, nbr_out_loc)
+    w = _nbr_rows(wire.astype(jnp.int32), nbr_out_loc, axis, xg, "out")
+    tg = _nbr_rows(target, nbr_out_loc, axis, xg, "out")
     return (w * (tg == ids[:, None])).sum(1)
 
 
@@ -259,13 +311,13 @@ def reply_counts_by_target_kreg(wire, target, nbr_out_loc, ids, axis=None):
 
 
 def bcast_counts_stat_kreg(key, send, nbr_in_loc, ids, probs: np.ndarray,
-                           drop=0.0, axis=None, mode="exact"):
+                           drop=0.0, axis=None, mode="exact", xg=None):
     """Stat twin of dv.bcast_counts_stat over the overlay: receiver j hears
     from its ACTIVE in-neighbors (gathered count), buckets multinomial.
     At k = N-1 the gathered count equals ``n_senders - is_sender`` and the
     chain is bit-equal to the dense stat path.  [B, N_loc]."""
     k = dv._shard_key(key, axis)
-    m = in_counts(send, nbr_in_loc, ids, axis)
+    m = in_counts(send, nbr_in_loc, ids, axis, xg)
     if drop > 0.0:
         m = jnp.round(
             binom(jax.random.fold_in(k, 0x0D10), m, 1.0 - drop, mode)
@@ -275,14 +327,13 @@ def bcast_counts_stat_kreg(key, send, nbr_in_loc, ids, probs: np.ndarray,
 
 def push_bcast_slots_stat_kreg(buf, t, push_lo: int, key, slot_mat,
                                nbr_in_loc, ids, probs: np.ndarray, drop=0.0,
-                               axis=None, mode="exact"):
+                               axis=None, mode="exact", xg=None):
     """Fused stat slot broadcast over the overlay (the kregular twin of
     dv.push_bcast_slots_stat): per-(receiver, slot) sender counts come
     from an in-neighbor gather-sum, then ride the same fused
     chain-into-ring push on the same key."""
     k = dv._shard_key(key, axis)
-    sm_g = dv._gather(slot_mat.astype(jnp.int32), axis)
-    vals = jnp.take(sm_g, nbr_in_loc, axis=0)            # [N_loc, K, S]
+    vals = _nbr_rows(slot_mat.astype(jnp.int32), nbr_in_loc, axis, xg)
     notself = (nbr_in_loc != ids[:, None]).astype(jnp.int32)
     m = (vals * notself[:, :, None]).sum(1)              # [N_loc, S]
     if drop > 0.0:
@@ -293,7 +344,7 @@ def push_bcast_slots_stat_kreg(buf, t, push_lo: int, key, slot_mat,
 
 
 def bcast_value_max_stat_kreg(key, value, nbr_in_loc, probs: np.ndarray,
-                              drop=0.0, axis=None):
+                              drop=0.0, axis=None, xg=None):
     """Stat twin of dv.bcast_value_max_stat over the overlay: each receiver
     gets the max value announced in its IN-neighborhood (self included —
     matching the dense global max, where re-delivery to the announcer is a
@@ -301,8 +352,7 @@ def bcast_value_max_stat_kreg(key, value, nbr_in_loc, probs: np.ndarray,
     [B, N_loc]."""
     k = dv._shard_key(key, axis)
     n_loc = value.shape[0]
-    value_g = dv._gather(value.astype(jnp.int32), axis)
-    vmax = jnp.take(value_g, nbr_in_loc).max(1)          # [N_loc]
+    vmax = _nbr_rows(value.astype(jnp.int32), nbr_in_loc, axis, xg).max(1)
     nb = len(probs)
     d = jax.random.categorical(k, jnp.log(jnp.asarray(probs) + 1e-30),
                                shape=(n_loc,))
@@ -319,7 +369,8 @@ def bcast_value_max_stat_kreg(key, value, nbr_in_loc, probs: np.ndarray,
 
 
 def bcast_window_value_max_stat_kreg(key, value_mat, nbr_in_loc,
-                                     probs: np.ndarray, drop=0.0, axis=None):
+                                     probs: np.ndarray, drop=0.0, axis=None,
+                                     xg=None):
     """Stat twin of dv.bcast_window_value_max_stat over the overlay:
     per-(receiver, window) in-neighborhood max, one delay draw each; a
     receiver whose own announcement IS the neighborhood max is the sender
@@ -327,8 +378,7 @@ def bcast_window_value_max_stat_kreg(key, value_mat, nbr_in_loc,
     k = dv._shard_key(key, axis)
     vm = value_mat.astype(jnp.int32)
     n_loc, w = vm.shape
-    value_g = dv._gather(vm, axis)
-    vmax = jnp.take(value_g, nbr_in_loc, axis=0).max(1)  # [N_loc, W]
+    vmax = _nbr_rows(vm, nbr_in_loc, axis, xg).max(1)    # [N_loc, W]
     nb = len(probs)
     d = jax.random.categorical(k, jnp.log(jnp.asarray(probs) + 1e-30),
                                shape=(n_loc, w))

@@ -278,6 +278,122 @@ def node_dim_rules(replicated_names=()):
     return rules + ((r".*", P(NODES_AXIS)),)
 
 
+# ------------------------------------------- shard-local neighbor exchange ---
+
+
+class NeighborExchange:
+    """Owner-bucketed cross-shard neighbor reads — the runtime half of
+    ``topo.spec.owner_bucket_plan``.
+
+    ``xg(x, kind="in")`` computes exactly ``jnp.take(x, table, axis=0)``
+    for the kind's ``[N, K]`` overlay table, and ``xg(x, kind=..., col=c)``
+    exactly ``jnp.take(x.reshape(-1), table * x.shape[1] + c)`` — but the
+    only communication is ONE ``all_to_all`` of the static ``[D, C, ...]``
+    owner buckets per call under ``shard_map``: no operand, intermediate,
+    or gather result is ever materialized at global shape on any device.
+    The result is a pure permutation + local gather of ``x``'s rows, so it
+    is bit-equal to the global gather by construction (pinned in
+    tests/test_zzexchange.py at mesh sizes 1/2/4/8).
+
+    The plan arrays (``pos``/``send`` per table kind) are PROGRAM OPERANDS
+    (traced, ``P(nodes)``-sharded), not constants: they ride the compiled
+    program next to the table operands (sweep.sharded_topo_sim_fn), so the
+    executable stays one-per-fault-structure and carries no O(N) consts
+    (the <64KB jaxpr-consts pin in tests/test_zzshardtopo.py).
+    """
+
+    def __init__(self, mesh, n: int, plans: dict):
+        if not plans:
+            raise ValueError("NeighborExchange needs at least one plan")
+        self.mesh = mesh
+        self.n = int(n)
+        self.plans = dict(plans)
+        pos, send = next(iter(self.plans.values()))
+        self.n_shards = int(send.shape[0])
+        self.n_pad = int(pos.shape[0])
+
+    def _pad(self, a):
+        import jax.numpy as jnp
+
+        pad = self.n_pad - int(a.shape[0])
+        if pad:
+            a = jnp.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1))
+        return a
+
+    def __call__(self, x, kind: str = "in", col=None):
+        import jax.numpy as jnp
+        from jax import lax
+
+        from blockchain_simulator_tpu.parallel.mesh import NODES_AXIS
+
+        pos, send = self.plans[kind]
+        d, c = int(send.shape[0]), int(send.shape[2])
+        P = _spec_cls()
+        sliced = self.n_pad - int(x.shape[0])
+        x = self._pad(x)
+
+        if col is None:
+            def body(x_loc, pos_loc, send_loc):
+                sb = jnp.take(x_loc, send_loc[0], axis=0)     # [D, C, ...]
+                rb = lax.all_to_all(sb, NODES_AXIS,
+                                    split_axis=0, concat_axis=0)
+                flat = rb.reshape((d * c,) + rb.shape[2:])
+                return jnp.take(flat, pos_loc, axis=0)        # [n_loc, K, .]
+            out = _shard_map(
+                body, self.mesh,
+                (P(NODES_AXIS), P(NODES_AXIS), P(NODES_AXIS)),
+                P(NODES_AXIS),
+            )(x, pos, send)
+        else:
+            w = int(x.shape[1])
+            col = self._pad(col)
+
+            def body(x_loc, pos_loc, send_loc, col_loc):
+                sb = jnp.take(x_loc, send_loc[0], axis=0)     # [D, C, w]
+                rb = lax.all_to_all(sb, NODES_AXIS,
+                                    split_axis=0, concat_axis=0)
+                flat = rb.reshape((d * c * w,))
+                return jnp.take(flat, pos_loc * w + col_loc, axis=0)
+            out = _shard_map(
+                body, self.mesh,
+                (P(NODES_AXIS), P(NODES_AXIS), P(NODES_AXIS), P(NODES_AXIS)),
+                P(NODES_AXIS),
+            )(x, pos, send, col)
+        return out[: self.n] if sliced else out
+
+
+class ExchangeSpec:
+    """Static description of the exchange-plan operand block a sharded
+    kregular program appends after its table operands: ``(pos, send)`` per
+    table kind, in ``kinds`` order.  The factory (sweep.sharded_topo_sim_fn)
+    builds the plan arrays once per executable from the PADDED tables
+    (topo.spec.owner_bucket_plan) and threads this spec through
+    runner.make_topo_dyn_sim_fn so the traced sim can rebind them into a
+    :class:`NeighborExchange` at trace time."""
+
+    def __init__(self, mesh, n: int, kinds=("in", "out")):
+        self.mesh = mesh
+        self.n = int(n)
+        self.kinds = tuple(kinds)
+
+    @property
+    def n_operands(self) -> int:
+        return 2 * len(self.kinds)
+
+    def build(self, *plan_operands) -> NeighborExchange:
+        if len(plan_operands) != self.n_operands:
+            raise ValueError(
+                f"ExchangeSpec.build: expected {self.n_operands} plan "
+                f"operands ({'/'.join(self.kinds)} pos+send), got "
+                f"{len(plan_operands)}"
+            )
+        plans = {
+            k: (plan_operands[2 * i], plan_operands[2 * i + 1])
+            for i, k in enumerate(self.kinds)
+        }
+        return NeighborExchange(self.mesh, self.n, plans)
+
+
 # ----------------------------------------------------- mesh-sweep helpers ---
 
 

@@ -160,7 +160,7 @@ def mesh_dyn_batched_fn(cfg: SimConfig, mesh):
 
 
 @aotcache.cached_factory("shard-topo-sim")
-def sharded_topo_sim_fn(cfg: SimConfig, mesh):
+def sharded_topo_sim_fn(cfg: SimConfig, mesh, layout: str = "exchange"):
     """Node-dim mesh-sharded topology program: ``sim(key, n_crashed,
     n_byzantine) -> final_state`` for a kregular or committee config with
     the overlay partitioned over the mesh's ``nodes`` axis — the 10M-node
@@ -182,19 +182,39 @@ def sharded_topo_sim_fn(cfg: SimConfig, mesh):
       ``partition.partition`` with the tables and every node-dim final
       sharded ``P(NODES_AXIS)`` (partition.node_dim_rules; the protocol's
       ``GLOBAL_FIELDS`` replicate).  The model traces in global view
-      (``cfg.mesh_axis`` stays None) so the cross-shard neighbor reads
-      stay plain ``jnp.take`` gathers for XLA GSPMD to partition — the
-      traced computation is identical to the single-device program, hence
-      bit-equal results under the exact sampler (tests/test_zzshardtopo).
-      The sharded tables are device_put once per factory call and closed
-      over; ``sim.partitioned`` / ``sim.table_avals`` expose the inner
-      pjit callable and table avals so the graph audit traces the
-      tables-as-operands jaxpr (zero large-jaxpr-constant findings).
+      (``cfg.mesh_axis`` stays None), so the traced computation — RNG
+      draw shapes included — is identical to the single-device program,
+      hence bit-equal results under the exact sampler
+      (tests/test_zzshardtopo).  Two data-movement layouts:
+
+      * ``layout="exchange"`` (the default): cross-shard neighbor reads
+        route through a ``partition.NeighborExchange`` — owner-bucketed
+        ``all_to_all`` islands (plans from topo/spec.owner_bucket_plan
+        ride as extra ``P(NODES_AXIS)`` operands) — and the table rows
+        pass through ``local_tables(ids=None)`` untaken, so no tensor is
+        ever materialized at global shape: prologue and per-tick comms
+        are O(N*K/D) per device instead of the full-table all-gather.
+        The exchange is a pure permutation + local gather, bit-equal to
+        the global gather by construction.
+      * ``layout="regather"``: the pre-exchange behavior — neighbor
+        reads stay plain ``jnp.take`` gathers for XLA GSPMD to
+        partition, which re-gathers the ``P(nodes)`` tables/state on
+        every device (the retired ``table-regather`` debt).  Kept so
+        tools/gather_locality_bench.py can measure old-vs-new inside one
+        artifact, and as the fallback if an exchange regression ever
+        needs bisecting.
+
+      The sharded tables (and exchange plans) are device_put once per
+      factory call and closed over; ``sim.partitioned`` /
+      ``sim.table_avals`` expose the inner pjit callable and its sharded
+      operand avals so the graph/comms audits trace the
+      operands-as-arguments jaxpr (zero large-jaxpr-constant findings).
       Uneven ``n % shards`` is fine: explicit NamedShardings must divide
       evenly in this jax, so the factory zero-pads the table rows to the
       next multiple (the wrapper slices them back before the engine sees
-      them — padding rows are never read) and any final whose node dim
-      stays uneven replicates instead of sharding.
+      them — padding rows are never read; exchange plans are built on the
+      padded tables and stay padded) and any final whose node dim stays
+      uneven replicates instead of sharding.
     - **committee, nodes > 1**: shard_map over the STACKED committee axis
       (``committees % shards == 0`` required): each device runs
       ``topo/committee.stacked_body`` — the same ``lax.map`` of the
@@ -269,7 +289,11 @@ def sharded_topo_sim_fn(cfg: SimConfig, mesh):
 
         return sim
 
-    inner_fn = make_topo_dyn_sim_fn(cfg)
+    if layout not in ("exchange", "regather"):
+        raise ValueError(
+            f"sharded_topo_sim_fn layout must be 'exchange' or 'regather', "
+            f"got {layout!r}"
+        )
     proto = base_model.get_protocol(cfg.protocol)
     tables = gd.table_operands(cfg, inslot=topo_tables_inslot(cfg))
     # explicit NamedShardings must divide evenly (jax 0.4 pjit aval
@@ -282,15 +306,32 @@ def sharded_topo_sim_fn(cfg: SimConfig, mesh):
             np.pad(t, ((0, pad),) + ((0, 0),) * (t.ndim - 1))
             for t in tables
         )
+    n_tables = len(tables)
+    if layout == "exchange":
+        from blockchain_simulator_tpu.topo import spec as topo_spec
 
-        def fn(key, n_crashed, n_byzantine, *tabs):
+        # plans over the PADDED tables: pad rows only reference row 0 (an
+        # extra shipped row at worst), and the exchange output is sliced
+        # back to cfg.n rows inside NeighborExchange
+        xspec = partition.ExchangeSpec(mesh, cfg.n)
+        plans = ()
+        for tab in (tables[0], tables[1]):  # "in", "out" — xspec.kinds
+            plans += topo_spec.owner_bucket_plan(tab, n_shards)
+        inner_fn = make_topo_dyn_sim_fn(cfg, exchange_spec=xspec)
+    else:
+        plans = ()
+        inner_fn = make_topo_dyn_sim_fn(cfg)
+    if pad:
+        def fn(key, n_crashed, n_byzantine, *ops):
             return inner_fn(
-                key, n_crashed, n_byzantine, *(t[: cfg.n] for t in tabs)
+                key, n_crashed, n_byzantine,
+                *(t[: cfg.n] for t in ops[:n_tables]), *ops[n_tables:]
             )
     else:
         fn = inner_fn
+    operands = tables + plans
     tab_sds = tuple(
-        jax.ShapeDtypeStruct(t.shape, jnp.int32) for t in tables
+        jax.ShapeDtypeStruct(t.shape, jnp.int32) for t in operands
     )
     key_sds = jax.eval_shape(lambda: jax.random.key(0))
     cnt_sds = jax.ShapeDtypeStruct((), jnp.int32)
@@ -315,20 +356,22 @@ def sharded_topo_sim_fn(cfg: SimConfig, mesh):
     table_spec = P(NODES_AXIS)
     p = partition.partition(
         fn, mesh,
-        in_shardings=(P(), P(), P()) + (table_spec,) * len(tables),
+        in_shardings=(P(), P(), P()) + (table_spec,) * len(operands),
         out_shardings=out_shardings,
     )
     ns = NamedSharding(mesh, table_spec)
-    tables_dev = tuple(jax.device_put(t, ns) for t in tables)
+    operands_dev = tuple(jax.device_put(t, ns) for t in operands)
 
     def sim(key, n_crashed, n_byzantine):
-        return p(key, n_crashed, n_byzantine, *tables_dev)
+        return p(key, n_crashed, n_byzantine, *operands_dev)
 
     # audit hooks: the graph specs trace `partitioned` with `table_avals`
-    # as arguments, so the audited jaxpr carries the tables as operands —
-    # the runtime closure above never re-bakes them either (device arrays)
+    # as arguments, so the audited jaxpr carries the tables (and, in
+    # exchange layout, the pos/send plans) as operands — the runtime
+    # closure above never re-bakes them either (device arrays)
     sim.partitioned = p
     sim.table_avals = tab_sds
+    sim.exchange_layout = layout
     return sim
 
 

@@ -340,7 +340,7 @@ def topo_tables_inslot(cfg: SimConfig) -> bool:
     return cfg.protocol == "raft"
 
 
-def make_topo_dyn_sim_fn(cfg: SimConfig):
+def make_topo_dyn_sim_fn(cfg: SimConfig, exchange_spec=None):
     """The tables-as-operands twin of :func:`make_dyn_sim_fn` for the
     kregular overlay: ``sim(key, n_crashed, n_byzantine, *tables) ->
     final_state`` where ``tables`` are the full ``[N, K]`` int32 overlay
@@ -350,6 +350,15 @@ def make_topo_dyn_sim_fn(cfg: SimConfig):
     overlays out of the jaxpr (KNOWN_ISSUES #0n's escape hatch, the
     large-jaxpr-constant graph rule) and lets parallel/sweep.py's
     ``sharded_topo_sim_fn`` shard them over the mesh's node axis.
+
+    With ``exchange_spec`` (a ``parallel.partition.ExchangeSpec``) the
+    operand list grows by the owner-bucketed exchange plans —
+    ``spec.n_operands`` extra arrays after the tables (pos+send per table
+    kind, topo/spec.owner_bucket_plan) — and every cross-row neighbor
+    read inside the tick body routes through the resulting
+    ``NeighborExchange`` instead of a global gather (the shard-local
+    layout of parallel/sweep.sharded_topo_sim_fn).  Values are bit-equal
+    either way; only the data movement differs.
 
     Same trace contract as ``make_dyn_sim_fn``: ``cfg`` is canonicalized,
     the function is returned UNJITTED (the caller owns the jit/pjit
@@ -372,12 +381,18 @@ def make_topo_dyn_sim_fn(cfg: SimConfig):
     n_tables = 3 if topo_tables_inslot(cfg) else 2
     proto = get_protocol(cfg.protocol)
 
-    def sim(key, n_crashed, n_byzantine, *tables):
-        if len(tables) != n_tables:
+    n_plans = exchange_spec.n_operands if exchange_spec is not None else 0
+
+    def sim(key, n_crashed, n_byzantine, *operands):
+        if len(operands) != n_tables + n_plans:
             raise ValueError(
                 f"{cfg.protocol} kregular sim takes {n_tables} overlay "
-                f"tables, got {len(tables)}"
+                f"tables{f' + {n_plans} exchange plans' if n_plans else ''}"
+                f", got {len(operands)}"
             )
+        tables = operands[:n_tables]
+        xg = (exchange_spec.build(*operands[n_tables:])
+              if exchange_spec is not None else None)
         state, bufs = proto.init(cfg, jax.random.fold_in(key, 0x1217))
         state = base_model.apply_fault_masks(
             cfg, state, *base_model.dyn_fault_masks(n, n_crashed, n_byzantine)
@@ -386,7 +401,7 @@ def make_topo_dyn_sim_fn(cfg: SimConfig):
         def body(carry, t):
             st, bf = carry
             st, bf = proto.step(cfg, st, bf, t, prng.tick_key(key, t),
-                                topo_tables=tables)
+                                topo_tables=tables, exchange=xg)
             return (st, bf), ()
 
         (state, bufs), _ = jax.lax.scan(

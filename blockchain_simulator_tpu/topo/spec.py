@@ -133,6 +133,77 @@ def inslot_table(n: int, degree: int, seed: int) -> np.ndarray:
     return _tables(n, degree, seed)[2]
 
 
+def owner_bucket_plan(table, n_shards: int, capacity: int | None = None):
+    """Build-time owner-bucketed exchange plan for a ``[N_pad, K]`` neighbor
+    table over ``n_shards`` node shards (N_pad divisible by n_shards;
+    row g lives on shard ``g // n_loc`` with ``n_loc = N_pad // n_shards``).
+
+    Returns ``(pos, send)``:
+
+    - ``send[o, d, :]`` — the **shard-local** row indices shard ``o`` must
+      ship to shard ``d``: the sorted distinct global rows referenced by
+      receiver d's table slice that are owned by o, minus ``o * n_loc``
+      (zero-padded to the bucket capacity C).  Shaped ``[D, D, C]`` so a
+      ``P(nodes)`` sharding hands each owner shard its own send row.
+    - ``pos[i, j]`` — where table entry ``table[i, j]`` lands in the
+      receiver's concatenated exchange buffer: after
+      ``all_to_all(take(x_loc, send[o] rows))`` flattens to ``[D * C, ...]``
+      on shard d, the row for global id g sits at
+      ``o * C + rank_of(g in bucket(d, o))``.  Shaped like ``table``.
+
+    The per-round capacity C is static: the max bucket size over every
+    (receiver, owner) pair, provably <= min(n_loc, K * n_loc) for a
+    k-regular overlay.  Passing an explicit ``capacity`` smaller than the
+    required C raises ``ValueError`` — overflow is a checked invariant,
+    never a silent truncation (undersized buffers would drop neighbor rows
+    and corrupt delivery counts silently otherwise).
+    """
+    table = np.asarray(table)
+    n_pad, _k = table.shape
+    if n_shards < 1 or n_pad % n_shards:
+        raise ValueError(
+            f"owner_bucket_plan: N_pad={n_pad} not divisible by "
+            f"n_shards={n_shards}"
+        )
+    n_loc = n_pad // n_shards
+    if table.size and (table.min() < 0 or table.max() >= n_pad):
+        raise ValueError("owner_bucket_plan: table entries outside [0, N_pad)")
+    # pass 1: per receiver shard, the sorted distinct referenced rows.
+    # owner(g) = g // n_loc is monotone in g, so each owner's bucket is a
+    # contiguous run of the sorted uniques — searchsorted finds the cuts.
+    per_recv = []
+    required = 0
+    shard_ids = np.arange(n_shards)
+    for d in range(n_shards):
+        uniq, inv = np.unique(table[d * n_loc:(d + 1) * n_loc],
+                              return_inverse=True)
+        starts = np.searchsorted(uniq // n_loc, shard_ids)
+        counts = np.diff(np.append(starts, len(uniq)))
+        required = max(required, int(counts.max()) if len(uniq) else 0)
+        per_recv.append((uniq, inv, starts))
+    if capacity is None:
+        capacity = required
+    elif capacity < required:
+        raise ValueError(
+            f"owner_bucket_plan: bucket capacity {capacity} < required "
+            f"{required} (n_shards={n_shards}, n_loc={n_loc}) — refusing to "
+            "truncate the exchange"
+        )
+    # pass 2: fill pos (receiver-buffer positions) and send (owner rows)
+    capacity = max(capacity, 1)
+    pos = np.empty_like(table, dtype=np.int32)
+    send = np.zeros((n_shards, n_shards, capacity), np.int32)
+    for d in range(n_shards):
+        uniq, inv, starts = per_recv[d]
+        own = uniq // n_loc
+        rank = np.arange(len(uniq)) - starts[own]
+        pos[d * n_loc:(d + 1) * n_loc] = (
+            own[inv] * capacity + rank[inv]
+        ).reshape(n_loc, -1).astype(np.int32)
+        send[own, d, rank] = (uniq - own * n_loc).astype(np.int32)
+    return pos, send
+
+
 def overlay_diameter(n: int, degree: int, seed: int) -> int:
     """BFS diameter of the out-digraph from node 0 (validation aid; the
     circulant is vertex-transitive, so one source suffices)."""

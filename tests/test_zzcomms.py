@@ -149,6 +149,29 @@ def test_table_regather_clean_without_matching_shape():
     assert [f for f in _check(meta) if f.rule == "table-regather"] == []
 
 
+def test_prologue_global_gather_fires_on_global_node_dim():
+    # Declared table is [8,6] (global node dim 8).  ag0's s32[8,4] output
+    # is NOT the exact table shape, so table-regather stays silent — but
+    # it still carries the global node dimension in the prologue, which
+    # is exactly the shard-local-exchange contract being violated.
+    meta = {"sharded_operands": [((8, 6), "int32")]}
+    fired = [f for f in _check(meta) if f.rule == "prologue-global-gather"]
+    assert len(fired) == 1
+    assert fired[0].detail == "all-gather s32[8,4]{1,0}"
+    assert fired[0].count == 1     # the loop-body ag is NOT a prologue hit
+
+
+def test_prologue_global_gather_defers_to_table_regather():
+    # When the prologue all-gather IS the exact declared table shape it is
+    # already counted by table-regather; one defect, one finding.
+    meta = {"sharded_operands": [((8, 4), "int32")]}
+    assert [f for f in _check(meta)
+            if f.rule == "prologue-global-gather"] == []
+    # and without any declared operands the rule has no node dim to key on
+    assert [f for f in _check()
+            if f.rule == "prologue-global-gather"] == []
+
+
 def test_collective_in_tick_loop_counts_loop_body_only():
     fired = {f.detail: f.count for f in _check()
              if f.rule == "collective-in-tick-loop"}

@@ -229,6 +229,26 @@ if [ "${SHARD_TOPO:-1}" != "0" ]; then
     fi
 fi
 
+# Gather-locality smoke (tools/gather_locality_bench.py --quick): the
+# shard-local exchange contract read straight off the post-SPMD HLO —
+# the kregular overlay program compiled under BOTH data-movement layouts
+# on the 8-virtual-device mesh, demanding the exchange layout carry ZERO
+# all-gathers (prologue bytes/device reduced >= (D-1)/D vs the regather
+# layout, all-to-all islands only); lands gather_prologue_reduction in
+# runs.jsonl (charted; the bench's own exit code is the gate).  GATHER=0
+# skips (~1 min of compiles on this box); the full-scale run (4M rung +
+# ticks/s ratio + 10M aval math) is `python tools/gather_locality_bench.py`
+# and the committed ARTIFACT_gather_locality.json.
+if [ "${GATHER:-1}" != "0" ]; then
+    echo "== gather locality smoke =="
+    python tools/gather_locality_bench.py --quick
+    gather_rc=$?
+    if [ "$gather_rc" -ne 0 ]; then
+        echo "lint.sh: gather locality smoke FAILED (rc=$gather_rc)" >&2
+        rc=1
+    fi
+fi
+
 # Telemetry report (tools/telemetry_report.py --quick): a real in-process
 # fleet drill (router -> replica -> batcher -> dispatch) with spans
 # captured — every admitted id must have a closed span tree and the named
